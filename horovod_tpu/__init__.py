@@ -1,0 +1,196 @@
+"""horovod_tpu — a TPU-native distributed deep-learning training framework
+with the capabilities of Horovod (reference at /root/reference).
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    # compiled path (hot): inside shard_map/jit, per-chip semantics
+    grads = jax.tree.map(lambda g: hvd.allreduce(g, axis_name="hvd"), grads)
+    # eager path: per-process semantics, named + async if desired
+    h = hvd.allreduce_async(np.ones(4), name="t0")
+    out = hvd.synchronize(h)
+
+Design (see SURVEY.md): the data plane is XLA collectives over a
+`jax.sharding.Mesh` riding ICI/DCN — not a port of the reference's
+NCCL/MPI rings. The reference's background thread, negotiation protocol,
+fusion buffers and response cache survive only in the slim eager/async
+runtime (`horovod_tpu.ops.queue`); the compiled path needs none of them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .common.context import (  # noqa: F401
+    DEFAULT_AXIS,
+    ProcessSet,
+    add_process_set,
+    ccl_built,
+    context,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    ddl_built,
+    global_process_set,
+    gloo_built,
+    gloo_enabled,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    num_shards,
+    rank,
+    remove_process_set,
+    rocm_built,
+    shard_id,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+    tpu_built,
+    tpu_enabled,
+)
+from .common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .ops.collectives import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_object,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_object,
+    grouped_allreduce,
+    join,
+    reducescatter,
+)
+from .ops.compression import Compression  # noqa: F401
+from .ops.queue import TensorEntry
+
+__version__ = "0.1.0"
+
+
+# ---------------------------------------------------------------------------
+# Async handle-based API (reference torch/mpi_ops.py:843-879: *_async, poll,
+# synchronize, wait_and_clear)
+# ---------------------------------------------------------------------------
+
+def _runtime():
+    ctx = context()
+    if ctx.runtime is None:
+        raise ValueError("horovod_tpu runtime not running; call hvd.init()")
+    return ctx.runtime
+
+
+def _default_name(prefix: str, tensor) -> str:
+    rt = _runtime()
+    return f"{prefix}.noname.{rt.handles._next}"
+
+
+def allreduce_async(tensor, average: Optional[bool] = None, name: Optional[str] = None,
+                    *, op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0, process_set: Optional[ProcessSet] = None) -> int:
+    from .ops.collectives import _resolve_op
+
+    rt = _runtime()
+    return rt.enqueue(TensorEntry(
+        name=name or _default_name("allreduce", tensor), op="allreduce",
+        tensor=np.asarray(tensor), reduce_op=_resolve_op(op, average),
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    rt = _runtime()
+    return rt.enqueue(TensorEntry(
+        name=name or _default_name("allgather", tensor), op="allgather",
+        tensor=np.asarray(tensor), process_set=process_set))
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    rt = _runtime()
+    return rt.enqueue(TensorEntry(
+        name=name or _default_name("broadcast", tensor), op="broadcast",
+        tensor=np.asarray(tensor), root_rank=root_rank, process_set=process_set))
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    rt = _runtime()
+    return rt.enqueue(TensorEntry(
+        name=name or _default_name("alltoall", tensor), op="alltoall",
+        tensor=np.asarray(tensor), splits=splits, process_set=process_set))
+
+
+def reducescatter_async(tensor, name: Optional[str] = None, *,
+                        op: Optional[ReduceOp] = None,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    rt = _runtime()
+    return rt.enqueue(TensorEntry(
+        name=name or _default_name("reducescatter", tensor), op="reducescatter",
+        tensor=np.asarray(tensor), reduce_op=op or ReduceOp.SUM,
+        process_set=process_set))
+
+
+def grouped_allreduce_async(tensors, average: Optional[bool] = None,
+                            name: Optional[str] = None, *,
+                            op: Optional[ReduceOp] = None,
+                            process_set: Optional[ProcessSet] = None) -> list[int]:
+    """Enqueue a group in one shot; the cycle loop fuses them into a single
+    flat collective (reference grouped allreduce + GroupTable)."""
+    base = name or "grouped"
+    return [allreduce_async(t, average, f"{base}.{i}", op=op, process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def poll(handle: int) -> bool:
+    return _runtime().handles.poll(handle)
+
+
+def synchronize(handle: int):
+    return _runtime().handles.wait(handle)
+
+
+# alias matching torch naming
+wait = synchronize
+
+
+# ---------------------------------------------------------------------------
+# Parameter broadcast helpers (reference tensorflow/functions.py:47
+# broadcast_variables / torch broadcast_parameters)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None):
+    """Broadcast a pytree of arrays from ``root_rank`` — call once after
+    init so all workers start from identical weights."""
+    import jax
+
+    return jax.tree.map(
+        lambda p: broadcast(p, root_rank, process_set=process_set), params)
+
+
+# optimizer layer re-exports (JAX-first API)
+from .opt import (  # noqa: E402,F401
+    DistributedOptimizer,
+    DistributedGradientTransformation,
+    distributed_grad,
+)

@@ -1,0 +1,408 @@
+"""Global runtime context: init/shutdown, process sets, device mesh.
+
+TPU-native re-design of the reference's process-global state + background
+runtime (`HorovodGlobalState`, /root/reference/horovod/common/global_state.h:43;
+`InitializeHorovodOnce`, operations.cc:649). Key differences, by design:
+
+- On GPU-Horovod, one process == one GPU == one rank, and every collective is
+  negotiated between processes over MPI/Gloo and executed by NCCL.
+- On TPU, one Python process drives ``local_size()`` chips and collectives are
+  XLA programs over a `jax.sharding.Mesh` riding ICI (intra-slice) / DCN
+  (cross-slice). SPMD programs are already symmetric across chips, so the
+  per-tensor negotiation protocol (controller.cc:69 ComputeResponseList)
+  collapses for the compiled path; it survives (slim, in
+  `horovod_tpu.ops.queue`) only for the eager/dynamic path.
+
+Rank/size vocabulary (documented contract):
+
+- ``size()``   — total number of chips in the set (the data-parallel width a
+                 Horovod user expects for LR scaling).
+- ``rank()``   — global index of this process's first chip. ``rank() == 0``
+                 is true exactly on the coordinator process, so rank-0
+                 checkpoint/log idioms transfer unchanged.
+- ``local_size()`` / ``local_rank()`` — chips driven by this process / index
+                 of the first one within the host (always 0 for the first).
+- ``cross_size()`` / ``cross_rank()`` — number of processes / this process's
+                 index (the reference's cross-communicator,
+                 mpi_context.cc:147-156).
+
+Per-chip rank only exists *inside* compiled programs, via
+``jax.lax.axis_index(axis_name)`` — that is the TPU-native shape of the
+reference's per-GPU rank.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import env as env_schema
+from .env import RuntimeConfig
+from .exceptions import HorovodInternalError
+
+LOG = logging.getLogger("horovod_tpu")
+
+# Default axis name used by every collective when tracing inside shard_map.
+DEFAULT_AXIS = "hvd"
+# Process-level and local axes of the 2-D eager mesh.
+PROC_AXIS = "hvd_proc"
+LOCAL_AXIS = "hvd_local"
+
+
+def _sorted_devices():
+    """All addressable+global devices in (process_index, id) order."""
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+class ProcessSet:
+    """A named subset of chips with its own meshes.
+
+    TPU-native equivalent of an MPI (sub-)communicator
+    (/root/reference/horovod/common/basics.py:33-65 accepts ``comm``/ranks;
+    mpi_context.cc builds GLOBAL/LOCAL/CROSS comms). A ProcessSet owns:
+
+    - ``mesh``      — 1-D mesh over all member chips, axis ``"hvd"``; the
+                      data plane for flat collectives.
+    - ``mesh_2d``   — (process, local-chip) mesh, axes ``("hvd_proc",
+                      "hvd_local")``; used by eager process-level collectives
+                      and by hierarchical (intra-host ICI / cross-host DCN)
+                      strategies — the reference's LOCAL/CROSS communicator
+                      triad (common.h:119-123).
+    """
+
+    def __init__(self, name: str, devices: Sequence[jax.Device]):
+        self.name = name
+        self.devices = list(devices)
+        n = len(self.devices)
+        if n == 0:
+            raise ValueError("ProcessSet needs at least one device")
+        dev_arr = np.array(self.devices, dtype=object)
+        self.mesh = Mesh(dev_arr, (DEFAULT_AXIS,))
+        # group by owning process
+        procs = sorted({d.process_index for d in self.devices})
+        self._proc_indices = procs
+        by_proc = [[d for d in self.devices if d.process_index == p] for p in procs]
+        local_counts = {len(g) for g in by_proc}
+        if len(local_counts) == 1:
+            self.is_homogeneous = True
+            self.mesh_2d = Mesh(
+                np.array(by_proc, dtype=object), (PROC_AXIS, LOCAL_AXIS)
+            )
+        else:
+            # heterogeneous local counts: no rectangular 2-D mesh; eager path
+            # falls back to the flat mesh
+            self.is_homogeneous = False
+            self.mesh_2d = None
+
+    # --- sizes -------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_devices(self):
+        pid = jax.process_index()
+        return [d for d in self.devices if d.process_index == pid]
+
+    @property
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def rank(self) -> int:
+        """Global chip index of this process's first member device."""
+        pid = jax.process_index()
+        for i, d in enumerate(self.devices):
+            if d.process_index == pid:
+                return i
+        raise HorovodInternalError(
+            f"process {pid} owns no devices in process set {self.name!r}"
+        )
+
+    @property
+    def cross_size(self) -> int:
+        return len(self._proc_indices)
+
+    @property
+    def cross_rank(self) -> int:
+        return self._proc_indices.index(jax.process_index())
+
+    def included(self) -> bool:
+        pid = jax.process_index()
+        return any(d.process_index == pid for d in self.devices)
+
+    def __repr__(self):
+        return f"ProcessSet({self.name!r}, size={self.size})"
+
+
+class _Context:
+    """Process-global singleton (HorovodGlobalState equivalent)."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.config: RuntimeConfig = RuntimeConfig()
+        self.global_set: Optional[ProcessSet] = None
+        self.process_sets: dict[str, ProcessSet] = {}
+        self.runtime = None  # ops.queue.BackgroundRuntime, set by init()
+        self.timeline = None  # utils.timeline.Timeline
+        self.stall_inspector = None
+        self.autotuner = None
+        self.joined = False  # reference global_state.h:107-111
+
+
+_ctx = _Context()
+
+
+def context() -> _Context:
+    return _ctx
+
+
+def _maybe_init_distributed():
+    """Multi-host bootstrap: jax.distributed replaces MPI rendezvous.
+
+    The launcher (horovod_tpu.runner) sets HOROVOD_TPU_COORDINATOR /
+    NUM_PROCESSES / PROCESS_ID, the TPU-native equivalent of the env the
+    reference's gloo launcher injects (gloo_run.py:65 create_slot_env_vars).
+    """
+    coord = os.environ.get(env_schema.HOROVOD_TPU_COORDINATOR)
+    if not coord or jax.process_count() > 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ[env_schema.HOROVOD_TPU_NUM_PROCESSES]),
+            process_id=int(os.environ[env_schema.HOROVOD_TPU_PROCESS_ID]),
+        )
+        LOG.info("jax.distributed initialized via %s", coord)
+    except Exception as e:  # already initialized or single-host
+        LOG.warning("jax.distributed.initialize failed: %s", e)
+
+
+def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
+    """Initialize horovod_tpu (reference: hvd.init(), basics.py:33).
+
+    ``ranks`` optionally restricts the global process set to a subset of chip
+    indices — the moral equivalent of ``hvd.init(comm=ranks)``.
+
+    Unlike the reference there is no background *communication* thread to
+    spawn for the compiled path — XLA executes collectives inline in program
+    order over ICI. ``start_runtime`` starts the slim background cycle loop
+    that serves the *eager/async named-tensor* API
+    (`horovod_tpu.ops.queue.BackgroundRuntime`, the TPU-shaped remnant of
+    BackgroundThreadLoop, operations.cc:353).
+    """
+    with _ctx.lock:
+        if _ctx.initialized:
+            return
+        _maybe_init_distributed()
+        _ctx.config = RuntimeConfig.from_env()
+        devices = _sorted_devices()
+        if ranks is not None:
+            devices = [devices[i] for i in ranks]
+        _ctx.global_set = ProcessSet("global", devices)
+        _ctx.process_sets = {"global": _ctx.global_set}
+        _ctx.joined = False
+
+        from ..utils.timeline import Timeline
+
+        _ctx.timeline = Timeline(_ctx.config.timeline_filename,
+                                 mark_cycles=_ctx.config.timeline_mark_cycles)
+
+        if start_runtime:
+            from ..ops.queue import BackgroundRuntime
+            from ..utils.stall import StallInspector
+
+            _ctx.stall_inspector = StallInspector(
+                warning_time_s=_ctx.config.stall_warning_time_s,
+                shutdown_time_s=_ctx.config.stall_shutdown_time_s,
+                disabled=_ctx.config.stall_check_disable,
+            )
+            _ctx.runtime = BackgroundRuntime(
+                _ctx.global_set,
+                config=_ctx.config,
+                timeline=_ctx.timeline,
+                stall_inspector=_ctx.stall_inspector,
+            )
+            _ctx.runtime.start()
+            if _ctx.config.autotune:
+                from ..utils.autotune import Autotuner
+
+                _ctx.autotuner = Autotuner(_ctx.runtime, log_path=_ctx.config.autotune_log)
+        _ctx.initialized = True
+        LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
+
+
+def shutdown():
+    """Tear down (reference: horovod_shutdown, operations.cc:728).
+
+    Pending async operations fail with HorovodInternalError, mirroring
+    FinalizeTensorQueue (tensor_queue.h:35).
+    """
+    with _ctx.lock:
+        if not _ctx.initialized:
+            return
+        if _ctx.runtime is not None:
+            _ctx.runtime.stop()
+            _ctx.runtime = None
+        if _ctx.timeline is not None:
+            _ctx.timeline.close()
+            _ctx.timeline = None
+        _ctx.stall_inspector = None
+        _ctx.autotuner = None
+        _ctx.global_set = None
+        _ctx.process_sets = {}
+        _ctx.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def _require_init() -> _Context:
+    if not _ctx.initialized:
+        raise ValueError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+    return _ctx
+
+
+def is_initialized() -> bool:
+    return _ctx.initialized
+
+
+def global_process_set() -> ProcessSet:
+    return _require_init().global_set
+
+
+def add_process_set(ranks: Sequence[int], name: Optional[str] = None) -> ProcessSet:
+    """Create a sub-communicator over a subset of global chip indices."""
+    ctx = _require_init()
+    name = name or f"set_{','.join(map(str, ranks))}"
+    with ctx.lock:
+        if name in ctx.process_sets:
+            return ctx.process_sets[name]
+        devs = [ctx.global_set.devices[i] for i in ranks]
+        ps = ProcessSet(name, devs)
+        ctx.process_sets[name] = ps
+        return ps
+
+
+def remove_process_set(name: str):
+    ctx = _require_init()
+    with ctx.lock:
+        if name == "global":
+            raise ValueError("cannot remove the global process set")
+        ctx.process_sets.pop(name, None)
+
+
+# --- rank/size API (reference: operations.cc:766-910, basics.py) ------------
+
+def size() -> int:
+    return _require_init().global_set.size
+
+
+def rank() -> int:
+    return _require_init().global_set.rank
+
+
+def local_size() -> int:
+    return _require_init().global_set.local_size
+
+
+def local_rank() -> int:
+    return 0 if _require_init().global_set.local_size > 0 else -1
+
+
+def cross_size() -> int:
+    return _require_init().global_set.cross_size
+
+
+def cross_rank() -> int:
+    return _require_init().global_set.cross_rank
+
+
+def is_homogeneous() -> bool:
+    """True when every process drives the same number of chips
+    (reference: horovod_is_homogeneous, operations.cc:840)."""
+    return _require_init().global_set.is_homogeneous
+
+
+def shard_id() -> int:
+    """Input-pipeline shard index for this process (== cross_rank()).
+
+    New helper: on TPU, datasets shard per *process*, not per chip.
+    """
+    return cross_rank()
+
+
+def num_shards() -> int:
+    return cross_size()
+
+
+# --- capability probes (reference: operations.cc:846-910) --------------------
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def tpu_built() -> bool:
+    """The one that matters here."""
+    return True
+
+
+def tpu_enabled() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def start_timeline(filename: str, mark_cycles: bool = False):
+    """Runtime timeline control (reference operations.cc:738-764)."""
+    ctx = _require_init()
+    ctx.timeline.reopen(filename, mark_cycles=mark_cycles)
+
+
+def stop_timeline():
+    ctx = _require_init()
+    ctx.timeline.reopen("", mark_cycles=False)

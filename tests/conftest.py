@@ -1,0 +1,29 @@
+"""Test harness: 8 virtual CPU devices standing in for an 8-chip TPU slice.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node is
+simulated by multi-device on one machine. The reference runs the same pytest
+files under an N-process MPI launcher; on TPU the analogue is one process
+driving an N-device mesh (``--xla_force_host_platform_device_count``), with
+per-chip collective semantics exercised through shard_map.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_session():
+    hvd.init()
+    yield
+    hvd.shutdown()

@@ -1,0 +1,222 @@
+"""Collective numerics — the TPU analogue of the reference's
+test/parallel/test_*.py body (e.g. test_tensorflow.py TensorFlowTests):
+allreduce/allgather/broadcast/alltoall across dtypes, grouped ops, error
+paths. Per-chip semantics run through shard_map over the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common.context import DEFAULT_AXIS
+
+
+N = 8
+
+
+def smap(fn, in_specs=P(DEFAULT_AXIS), out_specs=P()):
+    mesh = hvd.global_process_set().mesh
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def per_chip(shape, dtype=np.float32, seed=0):
+    """[N, *shape] input; row i is chip i's tensor."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, *shape).astype(dtype)
+    return x
+
+
+# --- traced allreduce -------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, jnp.bfloat16, np.int32])
+def test_allreduce_sum(dtype):
+    x = np.arange(N * 4, dtype=np.float64).reshape(N, 4).astype(dtype)
+    out = smap(lambda v: hvd.allreduce(v.reshape(4), op=hvd.Sum))(x.reshape(N * 4))
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.asarray(x, np.float64).sum(0), rtol=1e-2)
+
+
+def test_allreduce_average():
+    x = per_chip((3, 5))
+    out = smap(lambda v: hvd.allreduce(v[0], average=True), in_specs=P(DEFAULT_AXIS))(x)
+    np.testing.assert_allclose(out, x.mean(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,ref", [(hvd.Min, np.min), (hvd.Max, np.max),
+                                    (hvd.Product, np.prod)])
+def test_allreduce_minmaxprod(op, ref):
+    x = per_chip((4,), seed=3)
+    out = smap(lambda v: hvd.allreduce(v[0], op=op))(x)
+    np.testing.assert_allclose(out, ref(x, axis=0), rtol=1e-5)
+
+
+def test_allreduce_prescale_postscale():
+    x = per_chip((6,))
+    out = smap(lambda v: hvd.allreduce(v[0], op=hvd.Sum, prescale_factor=2.0,
+                                       postscale_factor=0.25))(x)
+    np.testing.assert_allclose(out, x.sum(0) * 0.5, rtol=1e-5)
+
+
+def test_allreduce_average_int_raises():
+    with pytest.raises(ValueError):
+        hvd.allreduce(np.arange(4, dtype=np.int32), average=True)
+
+
+def test_allreduce_compression_fp16():
+    x = per_chip((8,))
+    out = smap(lambda v: hvd.allreduce(v[0], average=True,
+                                       compression=hvd.Compression.fp16))(x)
+    assert np.asarray(out).dtype == np.float32
+    np.testing.assert_allclose(out, x.mean(0), rtol=1e-2, atol=1e-2)
+
+
+# --- grouped / fused --------------------------------------------------------
+
+def test_grouped_allreduce_matches_individual():
+    xs = [per_chip((3,), seed=i) for i in range(3)]
+
+    def f(a, b, c):
+        outs = hvd.grouped_allreduce([a[0], b[0], c[0]], average=True)
+        return tuple(outs)
+
+    outs = smap(f, in_specs=(P(DEFAULT_AXIS),) * 3, out_specs=(P(),) * 3)(*xs)
+    for o, x in zip(outs, xs):
+        np.testing.assert_allclose(o, x.mean(0), rtol=1e-5)
+
+
+def test_grouped_allreduce_mixed_dtypes():
+    a = per_chip((4,), np.float32, 1)
+    b = per_chip((2, 2), np.float64, 2)
+
+    def f(a, b):
+        return tuple(hvd.grouped_allreduce([a[0], b[0]], op=hvd.Sum))
+
+    oa, ob = smap(f, in_specs=(P(DEFAULT_AXIS), P(DEFAULT_AXIS)),
+                  out_specs=(P(), P()))(a, b)
+    np.testing.assert_allclose(oa, a.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(ob, b.sum(0), rtol=1e-5)
+
+
+# --- allgather / broadcast / alltoall / reducescatter ----------------------
+
+def test_allgather():
+    x = per_chip((2, 3))
+    out = smap(lambda v: hvd.allgather(v.reshape(2, 3)),
+               in_specs=P(DEFAULT_AXIS))(x.reshape(N * 2, 3))
+    np.testing.assert_allclose(out, x.reshape(N * 2, 3), rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(root):
+    x = per_chip((4,))
+    out = smap(lambda v: hvd.broadcast(v[0], root_rank=root))(x)
+    np.testing.assert_allclose(out, x[root], rtol=1e-6)
+
+
+def test_alltoall_equal_splits():
+    # chip i sends value (i*N + j) to chip j
+    x = np.arange(N * N, dtype=np.float32).reshape(N, N)
+
+    def f(v):
+        out, recv = hvd.alltoall(v.reshape(N))
+        return out, recv
+
+    out, recv = smap(f, in_specs=P(DEFAULT_AXIS),
+                     out_specs=(P(DEFAULT_AXIS), P(DEFAULT_AXIS)))(x.reshape(N * N))
+    out = np.asarray(out).reshape(N, N)
+    np.testing.assert_allclose(out, x.T, rtol=1e-6)
+    assert np.all(np.asarray(recv).reshape(N, N) == 1)
+
+
+def test_reducescatter():
+    x = per_chip((N * 2,))
+    out = smap(lambda v: hvd.reducescatter(v[0], op=hvd.Sum),
+               out_specs=P(DEFAULT_AXIS))(x)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+# --- adasum properties ------------------------------------------------------
+
+def test_adasum_identical_gradients_average():
+    # identical vectors: adasum(a, a) = a  (combine rule gives a/2 + a/2)
+    v = np.random.RandomState(0).randn(16).astype(np.float32)
+    x = np.tile(v, (N, 1))
+    out = smap(lambda t: hvd.allreduce(t[0], op=hvd.Adasum))(x)
+    np.testing.assert_allclose(out, v, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_orthogonal_gradients_sum():
+    # pairwise-orthogonal vectors: adasum behaves like sum
+    x = np.zeros((N, N), np.float32)
+    for i in range(N):
+        x[i, i] = float(i + 1)
+    out = smap(lambda t: hvd.allreduce(t[0], op=hvd.Adasum))(x)
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_scale_invariance():
+    # adasum of {g, g} equals adasum of {k*g, k*g} / k — scale robustness
+    v = np.random.RandomState(1).randn(8).astype(np.float32)
+    x1 = np.tile(v, (N, 1))
+    x2 = np.tile(100.0 * v, (N, 1))
+    o1 = np.asarray(smap(lambda t: hvd.allreduce(t[0], op=hvd.Adasum))(x1))
+    o2 = np.asarray(smap(lambda t: hvd.allreduce(t[0], op=hvd.Adasum))(x2))
+    np.testing.assert_allclose(o2, 100.0 * o1, rtol=1e-4)
+
+
+# --- eager path (single process == identity semantics) ----------------------
+
+def test_eager_allreduce_single_process():
+    x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, average=True)), x,
+                               rtol=1e-6)
+
+
+def test_eager_broadcast_and_allgather():
+    x = np.arange(6, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(hvd.broadcast(x, 0)), x)
+    np.testing.assert_allclose(np.asarray(hvd.allgather(x.reshape(3, 2))),
+                               x.reshape(3, 2))
+
+
+def test_eager_alltoall_with_splits():
+    x = np.arange(5, dtype=np.float32)
+    out, recv = hvd.alltoall(x, splits=np.array([5]))
+    np.testing.assert_allclose(np.asarray(out), x)
+    assert np.asarray(recv).tolist() == [5]
+
+
+def test_object_collectives():
+    assert hvd.allgather_object({"a": 1}) == [{"a": 1}]
+    assert hvd.broadcast_object([1, 2, 3], root_rank=0) == [1, 2, 3]
+
+
+def test_join_and_barrier():
+    hvd.barrier()
+    assert hvd.join() == hvd.rank()
+
+
+# --- rank/size surface ------------------------------------------------------
+
+def test_topology():
+    assert hvd.size() == N
+    assert hvd.rank() == 0
+    assert hvd.local_size() == N
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+    assert hvd.tpu_built() and not hvd.nccl_built() and not hvd.mpi_built()
+
+
+def test_process_set_subset():
+    ps = hvd.add_process_set([0, 1, 2, 3], name="half")
+    assert ps.size == 4
+
+    mesh = ps.mesh
+    out = jax.shard_map(lambda v: jax.lax.psum(v, DEFAULT_AXIS), mesh=mesh,
+                        in_specs=P(DEFAULT_AXIS), out_specs=P())(
+        jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+    hvd.remove_process_set("half")
