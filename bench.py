@@ -1,0 +1,92 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+
+Mirrors the reference harness
+(/root/reference/examples/tensorflow2/tensorflow2_synthetic_benchmark.py):
+synthetic ImageNet-shaped data, full training step (forward + backward +
+gradient allreduce + update), report images/sec.
+
+Baseline for vs_baseline: the reference's published ResNet-101 synthetic
+number — 1656.82 img/s over 16 Pascal GPUs = 103.55 img/s per device
+(/root/reference/docs/benchmarks.rst:31-41; BASELINE.md). We run ResNet-50
+(the BASELINE.json target metric) per chip on whatever devices exist.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+from horovod_tpu.parallel import data_parallel_step
+
+BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
+
+PER_CHIP_BATCH = 64
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    hvd.init()
+    n = hvd.size()
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    batch = PER_CHIP_BATCH * n
+    images = jnp.asarray(
+        np.random.RandomState(0).randn(batch, 224, 224, 3), jnp.bfloat16)
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
+
+    variables = model.init(rng, images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
+    opt_state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def step(train_state, opt_state, images, labels):
+        params, batch_stats = train_state
+
+        def loss_fn(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images, train=True,
+                mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(labels, 1000)
+            loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+            return loss, upd["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, new_stats), opt_state, jax.lax.pmean(loss, "hvd")
+
+    compiled = data_parallel_step(step, batch_argnums=(2, 3))
+    state = (params, batch_stats)
+    for _ in range(WARMUP):
+        state, opt_state, loss = compiled(state, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, opt_state, loss = compiled(state, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * ITERS / dt
+    per_chip = img_per_sec / n
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

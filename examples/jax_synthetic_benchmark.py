@@ -1,0 +1,92 @@
+"""Synthetic throughput benchmark — mirror of the reference's
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py (same flags,
+same output format: "Img/sec per device" + total), on JAX/TPU.
+
+Example:
+    python examples/jax_synthetic_benchmark.py --model ResNet50 --batch-size 64
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import models
+from horovod_tpu.parallel import data_parallel_step
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50")
+    p.add_argument("--batch-size", type=int, default=64, help="per-chip")
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--use-adasum", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    model = getattr(models, args.model)(num_classes=1000, dtype=jnp.bfloat16)
+    n = hvd.size()
+    batch = args.batch_size * n
+    images = jnp.asarray(np.random.RandomState(0).randn(batch, 224, 224, 3),
+                         jnp.bfloat16)
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
+
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    compression = hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), compression=compression,
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+    opt_state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def step(state, opt_state, images, labels):
+        params, batch_stats = state
+
+        def loss_fn(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images, train=True,
+                mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(labels, 1000)
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)), upd
+        (loss, upd), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return ((optax.apply_updates(params, updates), upd["batch_stats"]),
+                opt_state, jax.lax.pmean(loss, "hvd"))
+
+    compiled = data_parallel_step(step, batch_argnums=(2, 3))
+    state = (params, batch_stats)
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, Batch size: {args.batch_size} per chip, "
+              f"Number of chips: {n}")
+    for _ in range(args.num_warmup_batches):
+        state, opt_state, loss = compiled(state, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            state, opt_state, loss = compiled(state, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rate = batch * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate / n:.1f} img/sec per chip")
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        print(f"Img/sec per chip: {mean / n:.1f} +-{1.96 * np.std(img_secs) / n:.1f}")
+        print(f"Total img/sec on {n} chip(s): {mean:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,3 @@
-
+from .mlp import MLP, MnistConvNet  # noqa: F401
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,36 @@
+"""Small MLP/convnet for MNIST-scale examples and tests (the reference's
+examples/pytorch/pytorch_mnist.py Net: two convs + two dense layers)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.features[-1], dtype=jnp.float32)(x)
+
+
+class MnistConvNet(nn.Module):
+    """Mirror of the reference MNIST net (pytorch_mnist.py Net)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.max_pool(nn.Conv(10, (5, 5))(x), (2, 2), strides=(2, 2)))
+        x = nn.relu(nn.max_pool(nn.Conv(20, (5, 5))(x), (2, 2), strides=(2, 2)))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50)(x))
+        return nn.Dense(10, dtype=jnp.float32)(x)

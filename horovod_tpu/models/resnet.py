@@ -1,0 +1,90 @@
+"""ResNet v1.5 family — the benchmark workhorse.
+
+The reference benchmarks Horovod with ResNet-50/101 synthetic throughput
+(/root/reference/docs/benchmarks.rst:31-41,
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py). This is a fresh
+flax implementation tuned for TPU:
+
+- compute dtype bfloat16 (MXU-native), params float32;
+- NHWC layout (XLA/TPU conv-friendly);
+- BatchNorm stats are per-chip by default, matching Horovod's per-GPU BN;
+  pass ``axis_name`` to synchronize them cross-chip (SyncBatchNorm,
+  reference tensorflow/sync_batch_norm.py / torch/sync_batch_norm.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None  # set to sync BN stats across chips
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
+                                    conv=conv, norm=norm, act=nn.relu)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 8, 36, 3], **kw)

@@ -1,0 +1,169 @@
+"""Decoder-only transformer LM, written for mesh sharding.
+
+Greenfield relative to the reference (Horovod is model-agnostic), but
+required by SURVEY.md §2.3/§5.7: TP/SP/PP must be first-class in the TPU
+framework. The model is pure-functional (params pytree + apply) with an
+explicit `param_specs`/`act_spec` sharding map so the same code runs:
+
+- single-chip,
+- dp×tp×sp under `jit` with GSPMD sharding constraints (XLA inserts the
+  psum for row-parallel matmuls and the reshards around attention),
+- under `shard_map` for the explicit ring-attention / Ulysses paths in
+  `horovod_tpu.parallel.sp`.
+
+Sharding layout (Megatron-style column→row pairs so each block needs one
+psum over 'tp'):
+  wq/wk/wv: (d_model, n_heads, head_dim)  heads sharded over 'tp'
+  wo:       (n_heads, head_dim, d_model)  heads sharded over 'tp'
+  w1:       (d_model, d_ff)               d_ff sharded over 'tp'
+  w2:       (d_ff, d_model)               d_ff sharded over 'tp'
+  activations: (batch, seq, d_model) — batch over 'dp', seq over 'sp'
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    # mesh axis names (None disables that sharding dimension)
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    sp_axis: Optional[str] = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init(rng, cfg: TransformerConfig):
+    keys = jax.random.split(rng, 4 + cfg.n_layers)
+    s = 0.02
+    params = {
+        "embed": s * jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32),
+        "pos": s * jax.random.normal(keys[1], (cfg.max_seq, cfg.d_model), jnp.float32),
+        "ln_f": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[4 + i], 6)
+        params["blocks"].append({
+            "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+            "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+            "wq": s * jax.random.normal(k[0], (cfg.d_model, cfg.n_heads, cfg.head_dim), jnp.float32),
+            "wk": s * jax.random.normal(k[1], (cfg.d_model, cfg.n_heads, cfg.head_dim), jnp.float32),
+            "wv": s * jax.random.normal(k[2], (cfg.d_model, cfg.n_heads, cfg.head_dim), jnp.float32),
+            "wo": s * jax.random.normal(k[3], (cfg.n_heads, cfg.head_dim, cfg.d_model), jnp.float32),
+            "w1": s * jax.random.normal(k[4], (cfg.d_model, cfg.d_ff), jnp.float32),
+            "w2": s * jax.random.normal(k[5], (cfg.d_ff, cfg.d_model), jnp.float32),
+        })
+    return params
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpec pytree matching `init` (for jit in_shardings)."""
+    tp = cfg.tp_axis
+    block = {
+        "ln1": {"scale": P()},
+        "ln2": {"scale": P()},
+        "wq": P(None, tp, None),
+        "wk": P(None, tp, None),
+        "wv": P(None, tp, None),
+        "wo": P(tp, None, None),
+        "w1": P(None, tp),
+        "w2": P(tp, None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "ln_f": {"scale": P()},
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def act_spec(cfg: TransformerConfig) -> P:
+    return P(cfg.dp_axis, cfg.sp_axis, None)
+
+
+def _rmsnorm(x, scale):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (y * scale).astype(x.dtype)
+
+
+def _constrain(x, spec, use_constraints):
+    if use_constraints:
+        return jax.lax.with_sharding_constraint(x, spec)
+    return x
+
+
+def apply(params, tokens, cfg: TransformerConfig, *, use_constraints: bool = True,
+          attn_fn=None, positions=None):
+    """Forward pass → logits (float32).
+
+    ``attn_fn(q, k, v)`` hook (q/k/v: [b, s, h, hd]) lets
+    `horovod_tpu.parallel.sp` substitute ring attention or Ulysses
+    attention; default is full causal attention (XLA reshards over 'sp'
+    automatically under GSPMD).
+
+    ``positions`` ([s] global position ids) must be supplied when running
+    inside a shard_map with the sequence sharded (ring attention): each
+    chip's block starts at ``axis_index * s_local``, not 0.
+    """
+    aspec = act_spec(cfg)
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x + params["pos"][positions].astype(cfg.dtype)[None]
+    x = _constrain(x, aspec, use_constraints)
+    for blk in params["blocks"]:
+        h = _rmsnorm(x, blk["ln1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bshk", h, blk["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, blk["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, blk["wv"].astype(cfg.dtype))
+        if attn_fn is None:
+            o = causal_attention(q, k, v)
+        else:
+            o = attn_fn(q, k, v)
+        o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(cfg.dtype))
+        x = _constrain(x + o, aspec, use_constraints)
+        h = _rmsnorm(x, blk["ln2"]["scale"])
+        ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, blk["w1"].astype(cfg.dtype)))
+        ff = jnp.einsum("bsf,fd->bsd", ff, blk["w2"].astype(cfg.dtype))
+        x = _constrain(x + ff, aspec, use_constraints)
+    x = _rmsnorm(x, params["ln_f"]["scale"])
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), params["embed"])
+    return logits
+
+
+def causal_attention(q, k, v):
+    """Plain causal attention, [b, s, h, hd] layout, f32 softmax."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bshk,bthk->bhst", q, k).astype(jnp.float32) * scale
+    s, t = logits.shape[-2], logits.shape[-1]
+    mask = jnp.tril(jnp.ones((s, t), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+
+def lm_loss(params, tokens, cfg: TransformerConfig, **kw):
+    """Next-token cross-entropy (mean over tokens)."""
+    logits = apply(params, tokens[:, :-1], cfg, **kw)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -1,1 +1,7 @@
-
+from .mesh import create_mesh, create_hierarchical_mesh, parse_mesh_spec  # noqa: F401
+from .dp import data_parallel_step, shard_batch  # noqa: F401
+from .tp import (column_parallel_dense, row_parallel_dense, parallel_mlp,  # noqa: F401
+                 parallel_attention_output, shard_leading)
+from .sp import ring_attention, ulysses_attention  # noqa: F401
+from .pp import pipeline_apply, pipeline_loss  # noqa: F401
+from .moe import moe_layer, top1_gating  # noqa: F401

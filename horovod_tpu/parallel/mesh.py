@@ -1,0 +1,82 @@
+"""Device-mesh construction: the TPU replacement for communicator plumbing.
+
+The reference builds a GLOBAL/LOCAL/CROSS communicator triad
+(/root/reference/horovod/common/mpi_context.cc:147-156 MPI_Comm_split_type /
+common.h:119-123) and selects NCCL rings over PCIe/IB. On TPU the
+equivalent object is a `jax.sharding.Mesh`: axes laid out so that
+collectives over intra-slice axes ride ICI and cross-slice axes ride DCN
+(`mesh_utils.create_hybrid_device_mesh`). Parallelism strategies are just
+axis names:
+
+    dp   — data parallel          (psum of gradients)
+    fsdp — fully-sharded DP       (all_gather params / reduce_scatter grads)
+    tp   — tensor parallel        (psum of partial matmuls)
+    pp   — pipeline parallel      (ppermute of activations)
+    sp   — sequence/context par.  (ring attention ppermute / Ulysses all_to_all)
+    ep   — expert parallel        (all_to_all token dispatch)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+KNOWN_AXES = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """Parse ``"dp=4,tp=2"`` (the HOROVOD_TPU_MESH env format)."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        k, v = part.split("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def create_mesh(axes: dict[str, int] | str | None = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a mesh with named axes over ``devices`` (default: all).
+
+    Axis order follows the convention that the *rightmost* axes change
+    fastest and therefore map to physically-adjacent chips — put ``tp``/
+    ``sp`` (latency-sensitive, every-layer collectives) rightmost and
+    ``dp``/``pp`` (once-per-step) leftmost, mirroring the scaling-book
+    recipe of keeping tensor-parallel groups within an ICI neighborhood.
+    """
+    if isinstance(axes, str):
+        axes = parse_mesh_spec(axes)
+    devices = list(devices) if devices is not None else jax.devices()
+    if not axes:
+        axes = {"dp": len(devices)}
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh {axes} needs {np.prod(shape)} devices, "
+                         f"have {len(devices)}")
+    try:
+        dev_arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_arr = np.array(devices, dtype=object).reshape(shape)
+    return Mesh(dev_arr, names)
+
+
+def create_hierarchical_mesh(ici_axes: dict[str, int], dcn_axes: dict[str, int]) -> Mesh:
+    """Multi-slice mesh: ``dcn_axes`` span slices (cross-slice collectives
+    ride DCN), ``ici_axes`` stay inside a slice. This is the reference's
+    hierarchical allreduce (NCCLHierarchicalAllreduce,
+    nccl_operations.cc:188-370) expressed as nested mesh axes: a psum over
+    ('dp_ici',) then ('dp_dcn',) is ReduceScatter-ICI → Allreduce-DCN →
+    AllGather-ICI, inserted automatically by XLA."""
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values()) + tuple(1 for _ in ici_axes)
+    ici_shape = tuple(1 for _ in dcn_axes) + tuple(ici_axes.values())
+    dev_arr = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=jax.devices())
+    return Mesh(dev_arr.reshape(shape), names)

@@ -1,0 +1,92 @@
+"""Expert parallelism (MoE) over the 'ep' mesh axis.
+
+The reference supports this only as a primitive — alltoall with uneven
+splits + received_splits (SURVEY.md §2.3, operations.cc:1131-1193). Here
+the full layer is provided: top-k gating with capacity, a dual
+``all_to_all`` dispatch/combine (the MoE hot path on ICI), and the uneven
+split problem solved the XLA way — capacity padding, since compiled
+programs need static shapes (SURVEY.md §7 hard part 6).
+
+Layout: inside shard_map over 'ep', each chip hosts
+``n_experts_total / ep`` experts and a token shard [t_local, d].
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_gating(gate_logits, n_experts: int, capacity: int):
+    """Switch-style top-1 gating with per-expert capacity.
+
+    Returns (dispatch [t, e, c] one-hot, combine [t, e, c] weights,
+    aux_loss) — the standard load-balancing auxiliary loss.
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [t]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [t, e]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [t, e], -1 where not routed
+    in_cap = (pos < capacity) & (pos >= 0)
+    pos = jnp.where(in_cap, pos, 0.0)
+    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32) * in_cap[..., None]
+    dispatch = onehot[..., None] * cap_onehot  # [t, e, c]
+    combine = dispatch * gate[:, None, None]
+    # load-balancing loss (Switch Transformer eq. 4)
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * n_experts
+    return dispatch, combine, aux
+
+
+def moe_layer(x, gate_w, expert_fn: Callable, expert_params, *,
+              axis_name: str = "ep", capacity_factor: float = 1.25):
+    """Expert-parallel MoE layer (per-chip view inside shard_map).
+
+    Args:
+      x: [t_local, d] local token shard.
+      gate_w: [d, n_experts_total] router weights (replicated).
+      expert_fn: ``expert_fn(expert_params, x) -> y`` applied to this
+        chip's local experts; ``expert_params`` leaves have leading dim
+        n_local_experts.
+      capacity_factor: capacity = factor * t_local / n_experts_total.
+
+    Returns (y [t_local, d], aux_loss).
+    """
+    n = lax.axis_size(axis_name)
+    t_local, d = x.shape
+    n_experts = gate_w.shape[-1]
+    if n_experts % n:
+        raise ValueError(f"experts ({n_experts}) must divide by ep={n}")
+    e_local = n_experts // n
+    capacity = max(1, int(capacity_factor * t_local / n_experts))
+
+    gate_logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    dispatch, combine, aux = top1_gating(gate_logits, n_experts, capacity)
+
+    # gather expert inputs: [e, c, d] then alltoall over experts' owner axis
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # [e, c, d] -> regroup as [n, e_local, c, d] and exchange: after
+    # all_to_all chip p holds, for each source chip, the slots of its local
+    # experts: [n (src chip), e_local, c, d]
+    expert_in = expert_in.reshape(n, e_local, capacity, d)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)  # [n, e_local, c, d]
+    # fold source-chip dim into the capacity dim and run local experts
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+    expert_in = expert_in.astype(x.dtype)
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)  # [e_local, n*c, d]
+    # reverse the exchange
+    expert_out = expert_out.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)  # [n, e_local, c, d]
+    expert_out = expert_out.reshape(n_experts, capacity, d)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    aux = lax.pmean(aux, axis_name)
+    return y.astype(x.dtype), aux

@@ -1,0 +1,91 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Absent in the reference (SURVEY.md §2.3). TPU-native design: the pipeline
+is a single SPMD program — every chip runs the same schedule loop over
+``n_micro + n_stages - 1`` ticks; activations move between neighbor stages
+with ``lax.ppermute`` (ICI hop), and `jax.grad` differentiates straight
+through the schedule (ppermute's transpose is the reverse ppermute), so
+the backward pipeline needs no hand-written schedule.
+
+This is the GPipe schedule (fill → steady → drain). The microbatch loop is
+a ``lax.scan``, so compile time is O(1) in the number of microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, inputs, *,
+                   axis_name: str = "pp", n_micro: int | None = None):
+    """Run a pipelined forward pass.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` — one pipeline stage,
+        same signature on every chip (SPMD); per-chip ``stage_params`` hold
+        that stage's weights (shard_map in_specs=P('pp') over a stacked
+        params pytree).
+      stage_params: this chip's stage weights.
+      inputs: [n_micro, mb, ...] microbatched inputs (replicated; only
+        stage 0 reads them).
+      n_micro: number of microbatches (defaults to inputs.shape[0]).
+
+    Returns: [n_micro, mb, ...] outputs (valid on the last stage; other
+      stages return zeros — close with a psum/select or read on stage
+      pp-1).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if n_micro is None:
+        n_micro = inputs.shape[0]
+    total = n_micro + n - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    mb_shape = inputs.shape[1:]
+    y0 = jax.eval_shape(stage_fn, stage_params, jnp.zeros(mb_shape, inputs.dtype))
+    if y0.shape != mb_shape:
+        raise ValueError(
+            f"stage_fn must preserve the microbatch shape for pipelining "
+            f"(got {mb_shape} -> {y0.shape})")
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 consumes microbatch t (clamped; masked out after n_micro)
+        t_in = jnp.clip(t, 0, n_micro - 1)
+        x0 = lax.dynamic_index_in_dim(inputs, t_in, axis=0, keepdims=False)
+        x = jnp.where(idx == 0, x0, recv)
+        y = stage_fn(stage_params, x)
+        # last stage records its result for microbatch t-(n-1)
+        t_out = t - (n - 1)
+        valid = jnp.logical_and(t_out >= 0, idx == n - 1)
+        outputs = lax.cond(
+            t_out >= 0,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, jnp.where(valid, y, jnp.zeros_like(y)), jnp.clip(t_out, 0, n_micro - 1), axis=0),
+            lambda o: o,
+            outputs)
+        recv = lax.ppermute(y, axis_name, fwd_perm)
+        return (recv, outputs), None
+
+    outputs0 = jnp.zeros((n_micro,) + mb_shape, inputs.dtype)
+    recv0 = jnp.zeros(mb_shape, inputs.dtype)
+    (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(total))
+    return outputs
+
+
+def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params, inputs,
+                  targets, *, axis_name: str = "pp", n_micro: int | None = None):
+    """Pipelined loss: forward through stages, loss on the last stage,
+    psum'd so every stage sees the same scalar (and the backward pipeline
+    flows back through the ppermutes under jax.grad)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    outputs = pipeline_apply(stage_fn, stage_params, inputs,
+                             axis_name=axis_name, n_micro=n_micro)
+    per_micro = loss_fn(outputs, targets)
+    local = jnp.where(idx == n - 1, per_micro, jnp.zeros_like(per_micro))
+    return lax.psum(local, axis_name)

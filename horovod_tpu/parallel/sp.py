@@ -1,0 +1,107 @@
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+Greenfield per SURVEY.md §5.7 — the reference has no long-context support;
+its only adjacent machinery is the alltoall primitive. Here both standard
+SP schemes are first-class, built on the mesh 'sp' axis:
+
+- **Ring attention** (`ring_attention`): K/V blocks rotate around the ring
+  via ``lax.ppermute`` (ICI neighbor exchange) while each chip accumulates
+  flash-style online-softmax statistics for its resident Q block. Causal
+  masking is done per block pair, so each chip does only the work its
+  Q-block needs. Communication is overlapped with the block computation by
+  XLA's latency-hiding scheduler.
+- **Ulysses** (`ulysses_attention`): two ``all_to_all`` reshuffles trade
+  the sequence sharding for a head sharding around the attention core
+  (DeepSpeed-Ulysses style, built on the same primitive the reference
+  exposes as hvd.alltoall).
+
+Inputs are per-chip blocks [batch, seq_local, heads, head_dim] inside a
+shard_map over the 'sp' axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn_stats(q, k, v, mask):
+    """One flash block: masked logits → (new partial max, exp-weights sums,
+    weighted values). q/k/v: [b, s, h, hd]; mask broadcastable [s, t]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bshk,bthk->bhst", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [b,h,s]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [b,h,s]
+    o = jnp.einsum("bhst,bthk->bshk", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, l, o
+
+
+def ring_attention(q, k, v, axis_name: str = "sp"):
+    """Causal ring attention over the 'sp' axis.
+
+    Sequence is block-sharded: chip i holds tokens
+    [i*s_loc, (i+1)*s_loc). Returns the attention output for the local
+    Q block, same shape/dtype as q.
+    """
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    s = q.shape[1]
+    b, h = q.shape[0], q.shape[2]
+    tril = jnp.tril(jnp.ones((s, s), bool))
+
+    m_acc = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((b, h, s), jnp.float32)
+    o_acc = jnp.zeros(q.shape[:1] + (s,) + q.shape[2:], jnp.float32)
+
+    perm = [(x, (x + 1) % n) for x in range(n)]
+    for r in range(n):
+        j = (i - r) % n  # source block index of the K/V currently resident
+        # causal block mask: full if j<i, triangular if j==i, empty if j>i.
+        # Round 0 is the diagonal block, so every row sees >=1 real entry
+        # before any fully-masked round — keeps the online softmax finite.
+        block_mask = jnp.where(j == i, tril, (j < i))
+        m_r, l_r, o_r = _block_attn_stats(q, k, v, block_mask)
+        m_new = jnp.maximum(m_acc, m_r)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_r - m_new)
+        l_acc = l_acc * alpha + l_r * beta
+        o_acc = (o_acc * alpha.transpose(0, 2, 1)[..., None]
+                 + o_r * beta.transpose(0, 2, 1)[..., None])
+        m_acc = m_new
+        if r != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    out = o_acc / l_acc.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", attn_fn=None):
+    """Ulysses SP: all_to_all seq⇄heads around a full attention core.
+
+    Requires heads % axis_size == 0. Each chip computes full-sequence
+    attention for its head shard — good when seq is long but heads are
+    plentiful; ring attention covers the opposite regime.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"heads ({q.shape[2]}) must divide by sp={n}")
+    if attn_fn is None:
+        from ..models.transformer import causal_attention
+
+        attn_fn = causal_attention
+
+    def scatter_heads(x):  # [b, s_loc, h, hd] -> [b, s, h/n, hd]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):  # [b, s, h/n, hd] -> [b, s_loc, h, hd]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    return gather_heads(attn_fn(scatter_heads(q), scatter_heads(k),
+                                scatter_heads(v)))

@@ -1,0 +1,64 @@
+"""Tensor (model) parallelism building blocks.
+
+Absent in the reference (SURVEY.md §2.3: closest hook is the
+sub-communicator, basics.py:33) — first-class here because the TPU
+substrate makes it natural: a Megatron-style column/row parallel pair costs
+exactly one ``psum`` over the 'tp' mesh axis, riding ICI.
+
+Two usage styles:
+
+- **GSPMD style** (recommended): shard the weights with
+  `horovod_tpu.models.transformer.param_specs`-like PartitionSpecs and let
+  XLA insert the collectives. Nothing to call here.
+- **Explicit style** (shard_map regions): the helpers below make the
+  collective placement explicit — column-parallel produces a sharded
+  activation with no communication; row-parallel consumes it and closes
+  with a single psum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(x, w_local, b_local=None):
+    """y_local = x @ W[:, shard] — weights sharded on the output dim, input
+    replicated across 'tp'. No communication."""
+    y = jnp.einsum("...d,df->...f", x, w_local)
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(x_local, w_local, axis_name: str, b=None):
+    """y = psum_tp(x[:, shard] @ W[shard, :]) — weights sharded on the input
+    dim, activations sharded from a preceding column-parallel layer. One
+    psum over 'tp' closes the pair."""
+    y = lax.psum(jnp.einsum("...f,fd->...d", x_local, w_local), axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def parallel_mlp(x, w1_local, w2_local, axis_name: str, act=jax.nn.gelu):
+    """Column→act→row parallel MLP: the canonical Megatron block shape."""
+    return row_parallel_dense(act(column_parallel_dense(x, w1_local)),
+                              w2_local, axis_name)
+
+
+def parallel_attention_output(o_heads_local, wo_local, axis_name: str):
+    """Attention output projection with heads sharded over 'tp':
+    o: [..., h_local, hd], wo_local: [h_local, hd, d] → psum over 'tp'."""
+    return lax.psum(jnp.einsum("...hk,hkd->...d", o_heads_local, wo_local),
+                    axis_name)
+
+
+def shard_leading(x, axis_name: str):
+    """Slice a replicated array's leading dim to this chip's shard —
+    explicit-style alternative to a sharding constraint."""
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    chunk = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, i * chunk, chunk, axis=0)
