@@ -173,16 +173,26 @@ def _maybe_init_distributed():
     reference's gloo launcher injects (gloo_run.py:65 create_slot_env_vars).
     """
     coord = os.environ.get(env_schema.HOROVOD_TPU_COORDINATOR)
-    if not coord or jax.process_count() > 1:
+    if not coord:
         return
+    nproc = int(os.environ.get(env_schema.HOROVOD_TPU_NUM_PROCESSES, "1"))
+    if nproc <= 1:
+        return
+    # IMPORTANT: do not touch jax.devices()/process_count() before this —
+    # any backend-initializing call makes jax.distributed.initialize
+    # impossible (it must run first in the process).
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ[env_schema.HOROVOD_TPU_NUM_PROCESSES]),
+            num_processes=nproc,
             process_id=int(os.environ[env_schema.HOROVOD_TPU_PROCESS_ID]),
         )
         LOG.info("jax.distributed initialized via %s", coord)
-    except Exception as e:  # already initialized or single-host
+    except Exception as e:
         LOG.warning("jax.distributed.initialize failed: %s", e)
 
 

@@ -1,1 +1,73 @@
+"""Elastic (fault-tolerant, resizable) training.
 
+Reference: /root/reference/horovod/common/elastic.py run_fn (:151-175) —
+the retry loop around the user's training function:
+
+    @hvd.elastic.run
+    def train(state):
+        ...
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state, epoch=0)
+    train(state)
+
+Semantics preserved: `HorovodInternalError` → restore committed state,
+re-initialize, retry; `HostsUpdatedInterrupt` → re-sync (no restore) and
+retry. See `horovod_tpu.elastic.driver` for the TPU-native restart model.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .discovery import FixedHosts, HostDiscovery, HostDiscoveryScript, HostManager
+from .driver import ElasticDriver
+from .registration import WorkerStateRegistry
+from .state import JaxState, ObjectState, State
+
+__all__ = [
+    "run", "State", "ObjectState", "JaxState", "ElasticDriver",
+    "HostDiscovery", "HostDiscoveryScript", "FixedHosts", "HostManager",
+    "WorkerStateRegistry", "HorovodInternalError", "HostsUpdatedInterrupt",
+]
+
+
+def _reinitialize():
+    """Re-init the collective runtime after a failure (reference
+    elastic.py:159 _reset: shutdown + init)."""
+    from ..common import context as ctx_mod
+    from ..ops.collectives import clear_eager_cache
+
+    ctx_mod.shutdown()
+    clear_eager_cache()
+    ctx_mod.init()
+
+
+def run(func):
+    """Decorator wrapping an elastic train function (reference
+    elastic.py:151-175)."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reinitialize()
+                state.on_reset()
+                reset_required = False
+            try:
+                if not skip_sync:  # reference elastic.py: `if not skip_sync`
+                    state.sync()
+                skip_sync = False
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                # graceful membership change: keep current state; a
+                # skip_sync update doesn't need the rank-0 broadcast either
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper

@@ -1,0 +1,254 @@
+"""Elastic driver: dynamic membership, stable rank assignment, restart.
+
+Reference: /root/reference/horovod/runner/elastic/driver.py —
+`ElasticDriver` polls the discovery script every second (:181-201), computes
+stable rank assignments keeping at least one surviving host (:233-248),
+spawns/kills worker slots, blacklists failing hosts, and coordinates
+rendezvous rounds with `WorkerStateRegistry`.
+
+TPU-native recovery model (deliberate divergence, documented): the
+reference re-rendezvouses *inside* surviving worker processes
+(gloo_context.cc:154-192 elastic scope). A JAX process cannot cheaply
+re-size its world in-process (the distributed runtime and all compiled
+programs are world-size-specialized), so on membership change the driver
+bumps the epoch, terminates workers, and relaunches them with fresh
+HOROVOD_* env; workers resume from their last committed `State` snapshot
+(`JaxState` filesystem store + rank-0 sync broadcast). Recompilation on
+resize is unavoidable on TPU either way — XLA programs embed the mesh.
+Within a process lifetime, `HorovodInternalError` recovery (collective
+failure) restores the in-memory snapshot without restart, same as the
+reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, Optional
+
+from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from ..runner.http_server import RendezvousServer
+from .discovery import HostDiscoveryScript, HostManager
+from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+
+LOG = logging.getLogger("horovod_tpu")
+
+DISCOVER_INTERVAL_S = 1.0
+
+
+class WorkerHandle:
+    """Minimal process handle protocol (test doubles use threads)."""
+
+    def poll(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def terminate(self):
+        raise NotImplementedError
+
+
+class _SubprocessWorker(WorkerHandle):
+    def __init__(self, popen: subprocess.Popen):
+        self.popen = popen
+
+    def poll(self):
+        return self.popen.poll()
+
+    def terminate(self):
+        try:
+            self.popen.send_signal(signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+
+class ElasticDriver:
+    def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None):
+        self.host_manager = HostManager(discovery)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.registry = WorkerStateRegistry()
+        self.rendezvous = RendezvousServer()
+        self._prev_host_order: list[str] = []
+        self._epoch = 0
+        self._resets = 0
+        self._stop = threading.Event()
+
+    # -- assignments ---------------------------------------------------------
+    def compute_assignments(self) -> list[SlotInfo]:
+        """Stable assignment (reference _update_host_assignments,
+        driver.py:233): surviving hosts keep their previous order (so rank 0
+        stays on a surviving host and in-memory state is recoverable from
+        it); new hosts append in sorted order."""
+        hosts = self.host_manager.current_hosts
+        if self._prev_host_order and not any(h in hosts for h in self._prev_host_order):
+            raise RuntimeError(
+                "no hosts from the previous round survive; cannot recover "
+                "state (reference driver.py:242-248)")
+        order = [h for h in self._prev_host_order if h in hosts]
+        order += sorted(h for h in hosts if h not in order)
+        np_avail = sum(hosts[h] for h in order)
+        np = min(np_avail, self.max_np) if self.max_np else np_avail
+        if np < self.min_np:
+            raise RuntimeError(
+                f"available slots {np_avail} < min_np {self.min_np}")
+        slots = get_host_assignments([HostInfo(h, hosts[h]) for h in order], np)
+        self._prev_host_order = order
+        return slots
+
+    # -- epoch / notification ------------------------------------------------
+    def publish_epoch(self):
+        from ..runner.http_server import KVStoreClient
+
+        client = KVStoreClient("127.0.0.1", self.rendezvous.port)
+        client.put("elastic", "epoch", str(self._epoch).encode())
+
+    def bump_epoch(self):
+        self._epoch += 1
+        self.publish_epoch()
+
+    # -- main loop -----------------------------------------------------------
+    def run(self, create_worker: Callable[[SlotInfo, dict], WorkerHandle],
+            base_env_fn: Callable[[SlotInfo], dict]) -> int:
+        """Rounds of launch→monitor until global success or unrecoverable
+        failure. Returns a process exit code."""
+        self.rendezvous.start()
+        self.host_manager.update_available_hosts()
+        self.publish_epoch()
+        while not self._stop.is_set():
+            try:
+                slots = self.compute_assignments()
+            except RuntimeError as e:
+                LOG.error("elastic: %s", e)
+                return 1
+            self.registry.reset()
+            workers: dict[int, tuple[SlotInfo, WorkerHandle]] = {}
+            for slot in slots:
+                env = base_env_fn(slot)
+                env["HOROVOD_ELASTIC_EPOCH"] = str(self._epoch)
+                env["HOROVOD_ELASTIC"] = "1"
+                workers[slot.rank] = (slot, create_worker(slot, env))
+            rc = self._monitor_round(workers)
+            if rc is not None:
+                return rc
+            # membership changed or failure: next round
+            if self.reset_limit is not None and self._resets >= self.reset_limit:
+                LOG.error("elastic: reset limit %d reached", self.reset_limit)
+                return 1
+        return 0
+
+    def _monitor_round(self, workers) -> Optional[int]:
+        """None → start a new round; int → final exit code."""
+        last_discovery = 0.0
+        alive = dict(workers)
+        failed_host = None
+        while alive:
+            now = time.monotonic()
+            if now - last_discovery >= DISCOVER_INTERVAL_S:
+                last_discovery = now
+                if self.host_manager.update_available_hosts():
+                    LOG.info("elastic: host membership changed; resetting")
+                    self._resets += 1
+                    self.bump_epoch()
+                    self._terminate(alive)
+                    return None
+            for rank in list(alive):
+                slot, h = alive[rank]
+                rc = h.poll()
+                if rc is None:
+                    continue
+                del alive[rank]
+                if rc == 0:
+                    self.registry.record(f"{slot.hostname}:{slot.local_rank}",
+                                         SUCCESS)
+                else:
+                    self.registry.record(f"{slot.hostname}:{slot.local_rank}",
+                                         FAILURE)
+                    failed_host = slot.hostname
+                    break
+            if failed_host:
+                LOG.warning("elastic: worker failed on %s; blacklisting",
+                            failed_host)
+                self.host_manager.blacklist(failed_host)
+                self._resets += 1
+                self.bump_epoch()
+                self._terminate(alive)
+                if self.host_manager.available_slots() >= self.min_np:
+                    return None
+                return 1
+            time.sleep(0.05)
+        return 0  # every worker exited 0
+
+    def _terminate(self, alive):
+        for slot, h in alive.values():
+            h.terminate()
+        deadline = time.monotonic() + 15
+        for slot, h in alive.values():
+            while h.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+        alive.clear()
+
+    def stop(self):
+        self._stop.set()
+        self.rendezvous.stop()
+
+
+def run_elastic(command: list[str], args) -> int:
+    """CLI entry (reference launch.py:621 _run_elastic →
+    gloo_run_elastic)."""
+    import socket
+    import sys
+    import tempfile
+    import uuid
+
+    from ..common import env as env_schema
+    from ..runner.launch import _free_port, _knob_env, build_ssh_command, slot_env
+
+    if not args.host_discovery_script:
+        raise SystemExit("elastic mode requires --host-discovery-script")
+    discovery = HostDiscoveryScript(args.host_discovery_script,
+                                    default_slots=args.slots_per_host)
+    driver = ElasticDriver(discovery, min_np=args.min_np or 1,
+                           max_np=args.max_np)
+    extra = _knob_env(args)
+    # committed-state store for the restart-based recovery model (see class
+    # docstring): same path string on every worker, resolved per host-local
+    # filesystem. Stable assignment keeps rank 0 on a surviving host, so the
+    # restored-then-broadcast state is the authoritative one.
+    extra.setdefault(
+        "HOROVOD_ELASTIC_STORE",
+        os.path.join(tempfile.gettempdir(),
+                     f"hvd_elastic_{uuid.uuid4().hex[:8]}.pkl"))
+
+    # one coordinator address per round: every slot of a round must share it
+    # (jax.distributed world bootstrap), and each round needs a fresh port —
+    # the previous incarnation's coordinator may still be tearing down.
+    coord_by_epoch: dict[int, str] = {}
+
+    def base_env(slot: SlotInfo) -> dict:
+        ep = driver._epoch
+        if ep not in coord_by_epoch:
+            coord_by_epoch[ep] = f"127.0.0.1:{_free_port()}"
+        return slot_env(slot, "127.0.0.1", driver.rendezvous.port,
+                        coord_by_epoch[ep], extra)
+
+    def create_worker(slot: SlotInfo, env: dict) -> WorkerHandle:
+        if slot.hostname in (socket.gethostname(), "localhost", "127.0.0.1"):
+            p = subprocess.Popen(command, env=env, stdout=sys.stdout,
+                                 stderr=sys.stderr)
+        else:
+            p = subprocess.Popen(
+                build_ssh_command(slot.hostname, command, env,
+                                  ssh_port=getattr(args, "ssh_port", None),
+                                  ssh_identity_file=getattr(
+                                      args, "ssh_identity_file", None)))
+        return _SubprocessWorker(p)
+
+    try:
+        return driver.run(create_worker, base_env)
+    finally:
+        driver.stop()

@@ -1,0 +1,183 @@
+"""Elastic worker state: commit / restore / sync.
+
+Reference: /root/reference/horovod/common/elastic.py — `State` with
+commit/save/restore/sync + reset callbacks, `ObjectState` (:116), and the
+per-framework states (torch/elastic/state.py TorchState with
+Model/Optimizer/Sampler handlers).
+
+TPU-native notes: snapshots of JAX pytrees are host numpy copies (device
+buffers are invalidated by a TPU re-initialization, so an HBM snapshot
+would not survive the event we are protecting against). ``sync()``
+broadcasts from rank 0 with the object/parameter collectives.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..common.exceptions import HostsUpdatedInterrupt
+
+
+class State:
+    """Base elastic state (reference common/elastic.py:27-115)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: list[Callable] = []
+        self._host_messages = _HostUpdateListener()
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages.clear()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self):
+        self._host_messages.bump()
+
+    def commit(self):
+        """Snapshot + check for membership changes (reference :60-72:
+        commit = save + check_host_updates)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt if membership changed
+        (reference :73-96; consistency across ranks comes from every
+        worker polling the same driver epoch)."""
+        if self._host_messages.changed():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class _HostUpdateListener:
+    """Polls the driver's discovery epoch in the rendezvous KV store.
+
+    Replaces the reference's push-based WorkerNotificationService
+    (elastic/worker.py): the driver bumps ``elastic/epoch``; workers
+    compare against the epoch they started from (env HOROVOD_ELASTIC_EPOCH).
+    """
+
+    def __init__(self):
+        self._base_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+        addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        self._client = None
+        if addr and port:
+            from ..runner.http_server import KVStoreClient
+
+            self._client = KVStoreClient(addr, int(port))
+        self._forced = False
+
+    def bump(self):
+        self._forced = True
+
+    def clear(self):
+        self._forced = False
+        self._base_epoch = self.current_epoch()
+
+    def current_epoch(self) -> int:
+        if self._client is None:
+            return self._base_epoch
+        try:
+            return int(self._client.get("elastic", "epoch", timeout=1.0))
+        except Exception:
+            return self._base_epoch
+
+    def changed(self) -> bool:
+        return self._forced or self.current_epoch() != self._base_epoch
+
+
+class ObjectState(State):
+    """Elastic state of picklable attributes (reference ObjectState :116)."""
+
+    def __init__(self, store_path: Optional[str] = None, **kwargs):
+        super().__init__()
+        self._store_path = store_path or os.environ.get("HOROVOD_ELASTIC_STORE", "")
+        self._saved: dict = {}
+        self._attrs = list(kwargs.keys())
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        # resume semantics: a pre-existing store (left by a previous worker
+        # incarnation's commit) wins over the constructor defaults — this is
+        # how state survives the TPU restart-based resize (driver.py
+        # docstring); never clobber it with fresh defaults here.
+        if self._store_path and os.path.exists(self._store_path):
+            with open(self._store_path, "rb") as f:
+                self._saved = pickle.load(f)
+            self.restore()
+        else:
+            self.save()
+
+    def _snapshot(self) -> dict:
+        return {k: copy.deepcopy(getattr(self, k)) for k in self._attrs}
+
+    def save(self):
+        self._saved = self._snapshot()
+        if self._store_path:
+            tmp = self._store_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(self._saved, f)
+            os.replace(tmp, self._store_path)
+
+    def restore(self):
+        if not self._saved and self._store_path and os.path.exists(self._store_path):
+            with open(self._store_path, "rb") as f:
+                self._saved = pickle.load(f)
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        from ..ops.collectives import broadcast_object
+
+        for k in self._attrs:
+            setattr(self, k, broadcast_object(getattr(self, k), root_rank=0))
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state for JAX training: pytrees snapshot to host numpy
+    (the per-framework State of reference P3/P4, re-shaped for JAX).
+
+    Example:
+        state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                     epoch=0, batch=0)
+    """
+
+    def _snapshot(self) -> dict:
+        out = {}
+        for k in self._attrs:
+            v = getattr(self, k)
+            out[k] = jax.tree.map(
+                lambda x: np.asarray(x) if hasattr(x, "dtype") else copy.deepcopy(x), v)
+        return out
+
+    def sync(self):
+        from ..ops.collectives import broadcast_object
+        from ..ops.queue import TensorEntry  # noqa: F401  (runtime must be up)
+
+        for k in self._attrs:
+            v = getattr(self, k)
+            leaves, treedef = jax.tree.flatten(v)
+            if leaves and all(hasattr(l, "dtype") for l in leaves):
+                from .. import broadcast_parameters
+
+                setattr(self, k, broadcast_parameters(v, root_rank=0))
+            else:
+                setattr(self, k, broadcast_object(v, root_rank=0))
+        self.save()

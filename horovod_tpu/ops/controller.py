@@ -1,0 +1,196 @@
+"""Cross-process controller: negotiation of globally-ready named tensors.
+
+Reference: /root/reference/horovod/common/controller.cc —
+`ComputeResponseList` (:69): each cycle, workers send their ready tensor
+names to the coordinator (rank 0), which counts submissions
+(`IncrementTensorCount` :942), validates dtype/shape/op consistency
+(`ConstructResponse` :471-748 — mismatches become ERROR responses), orders
+and fuses ready tensors, and broadcasts the response list everyone must
+execute (`SendFinalTensors`).
+
+TPU-shaped differences:
+
+- Transport is the launcher's rendezvous HTTP KV store (the reference's
+  Gloo controller equally rides the launcher's HTTP store for bootstrap;
+  here it carries the negotiation itself — negligible traffic: names, not
+  tensors). Wire format is JSON (the role of the FlatBuffers schema,
+  common/wire/message.fbs: a size-stable, language-neutral encoding — JSON
+  chosen because the C++ side of this runtime is not built yet).
+- Only *eager async* ops negotiate. Compiled SPMD programs are symmetric
+  by construction and never enter this path — the negotiation protocol
+  survives exactly where dynamism is real (SURVEY.md §7 hard part 1).
+- The response carries the coordinator's submission order; every process
+  derives identical fusion groups from it locally (same deterministic
+  algorithm), replacing FuseResponses' look-ahead (:777-849).
+
+Protocol (round r, scope ``ctl``):
+  worker k:  PUT  ctl/r{r}/ready/{k}   = JSON [ [name, sig], ... ]
+  rank 0:    GET  ctl/r{r}/ready/* (all k) → count/validate/order
+             PUT  ctl/r{r}/resp        = JSON {"ready": [names...],
+                                               "errors": {name: msg}}
+  worker k:  GET  ctl/r{r}/resp (blocking) → execute / fail
+Rounds advance in lockstep; scope r-2 is garbage-collected by rank 0.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu")
+
+
+def entry_signature(entry) -> list:
+    """Consistency-checked fields (reference ConstructResponse checks
+    dtype :538, op :548, shape :596, devices :619).
+
+    Metadata only — reads .shape/.dtype attributes, never materializes the
+    tensor (a device array must not be copied to host once per cycle just
+    to describe it). Cached on the entry: signatures are immutable.
+    """
+    cached = getattr(entry, "_sig", None)
+    if cached is not None:
+        return cached
+    t = entry.tensor
+    shape = list(getattr(t, "shape", []))
+    dtype = str(getattr(t, "dtype", type(t).__name__))
+    sig = [entry.op, dtype, shape, int(entry.reduce_op),
+           entry.root_rank, float(entry.prescale_factor),
+           float(entry.postscale_factor)]
+    entry._sig = sig
+    return sig
+
+
+class KVController:
+    """One instance per process; rank 0 additionally runs the coordinator
+    loop in a background thread."""
+
+    # Worker waits for the response strictly longer than the coordinator
+    # waits for a straggling rank (STRAGGLER_TIMEOUT retry loop below), so a
+    # slow rank stalls the round, never desyncs it.
+    RESPONSE_TIMEOUT_S = 300.0
+
+    def __init__(self, client, rank: int, size: int,
+                 poll_timeout: float = RESPONSE_TIMEOUT_S):
+        self.client = client
+        self.rank = rank
+        self.size = size
+        self.round = 0
+        self.poll_timeout = poll_timeout
+        self.broken = False
+        self._coord: Optional[_Coordinator] = None
+        if rank == 0:
+            self._coord = _Coordinator(client, size)
+            self._coord.start()
+
+    def negotiate(self, pending: dict[str, list]) -> tuple[list[str], dict[str, str]]:
+        """Submit this process's ready set; return (ordered ready names,
+        per-name errors). Blocks for the round's response.
+
+        Any failure marks the controller broken: a worker that missed a
+        round can never rejoin the lockstep safely (other ranks may have
+        executed collectives it skipped), so the only sound recovery is the
+        reference's — surface HorovodInternalError and let elastic mode
+        re-initialize the world (common/elastic.py:151 semantics).
+        """
+        if self.broken:
+            raise RuntimeError("controller is broken; re-initialize horovod_tpu")
+        r = self.round
+        try:
+            payload = json.dumps([[n, sig] for n, sig in pending.items()]).encode()
+            self.client.put(f"ctl/r{r}", f"ready/{self.rank}", payload)
+            resp = json.loads(self.client.get(f"ctl/r{r}", "resp",
+                                              timeout=self.poll_timeout))
+        except Exception:
+            self.broken = True
+            raise
+        self.round += 1
+        return resp["ready"], resp.get("errors", {})
+
+    def stop(self):
+        if self._coord:
+            self._coord.stop()
+
+
+class _Coordinator(threading.Thread):
+    """Rank-0 aggregation loop (the MessageTable owner, controller.h:35)."""
+
+    def __init__(self, client, size: int):
+        super().__init__(daemon=True, name="hvd-coordinator")
+        self.client = client
+        self.size = size
+        self._stop_evt = threading.Event()
+        # name -> (sig, set of ranks that submitted) — persists across
+        # rounds like the reference's message_table_
+        self.table: dict[str, tuple[list, set[int]]] = {}
+        self.order: list[str] = []  # rank-0-submission-order tie break
+        self.errors: dict[str, str] = {}
+
+    # per-rank wait per attempt; transient misses retry until stop —
+    # a rank stuck in a long XLA compile must stall the round, not kill the
+    # coordinator (the reference tolerates stalls and only *warns*,
+    # stall_inspector.h:39)
+    STRAGGLER_TIMEOUT_S = 30.0
+
+    def _get_with_retry(self, scope: str, key: str) -> Optional[bytes]:
+        while not self._stop_evt.is_set():
+            try:
+                return self.client.get(scope, key,
+                                       timeout=self.STRAGGLER_TIMEOUT_S)
+            except Exception:
+                continue  # straggler: keep waiting for this rank
+        return None
+
+    def run(self):
+        r = 0
+        while not self._stop_evt.is_set():
+            try:
+                for k in range(self.size):
+                    raw = self._get_with_retry(f"ctl/r{r}", f"ready/{k}")
+                    if raw is None:
+                        return  # stopping
+                    for name, sig in json.loads(raw):
+                        self._increment(name, sig, k)
+                ready = [n for n in self.order
+                         if len(self.table[n][1]) == self.size]
+                errors = {n: self.errors[n] for n in list(self.errors)}
+                for n in ready:
+                    del self.table[n]
+                    self.order.remove(n)
+                for n in errors:
+                    self.table.pop(n, None)
+                    if n in self.order:
+                        self.order.remove(n)
+                    self.errors.pop(n, None)
+                self.client.put(f"ctl/r{r}", "resp",
+                                json.dumps({"ready": ready,
+                                            "errors": errors}).encode())
+                if r >= 2:
+                    self.client.delete_scope(f"ctl/r{r - 2}")
+                r += 1
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                LOG.warning("coordinator round %d error: %s", r, e)
+                return
+
+    def _increment(self, name: str, sig: list, rank: int):
+        """IncrementTensorCount + mismatch validation (controller.cc:942,
+        :471-748)."""
+        if name not in self.table:
+            self.table[name] = (sig, {rank})
+            self.order.append(name)
+            return
+        ref_sig, ranks = self.table[name]
+        if sig != ref_sig:
+            self.errors[name] = (
+                f"Mismatched submissions for tensor {name!r}: rank {rank} "
+                f"sent {sig}, previously {ref_sig} (reference "
+                "controller.cc:538-619 semantics)")
+            return
+        ranks.add(rank)
+
+    def stop(self):
+        self._stop_evt.set()

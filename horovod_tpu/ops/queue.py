@@ -83,7 +83,10 @@ class HandleManager:
 
     def mark_done(self, handle: int, result=None, exc: Optional[BaseException] = None):
         with self._lock:
-            ev, _, _ = self._results[handle]
+            rec = self._results.get(handle)
+            if rec is None:
+                return  # already consumed (shutdown race); nothing to signal
+            ev = rec[0]
             self._results[handle] = (ev, result, exc)
         ev.set()
 
@@ -166,6 +169,7 @@ class BackgroundRuntime:
         self.stall = stall_inspector
         self.queue = TensorQueue()
         self.handles = HandleManager()
+        self._pending: dict[str, TensorEntry] = {}  # negotiated-path backlog
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
@@ -173,6 +177,31 @@ class BackgroundRuntime:
         # is bytes/sec, parameter_manager.h:88)
         self.bytes_processed = 0
         self.cycles = 0
+        self.controller = self._maybe_controller()
+
+    def _maybe_controller(self):
+        """Cross-process negotiation over the launcher's rendezvous store —
+        only when there is real multi-process dynamism to coordinate."""
+        import os
+
+        from ..common import env as env_schema
+
+        if self.process_set.cross_size <= 1:
+            return None
+        addr = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+        port = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT)
+        if not addr or not port:
+            LOG.warning(
+                "multi-process run without a rendezvous store: eager async "
+                "ops fall back to name-ordered execution (launch with hvdrun "
+                "for full negotiation)")
+            return None
+        from ..runner.http_server import KVStoreClient
+        from .controller import KVController
+
+        return KVController(KVStoreClient(addr, int(port)),
+                            rank=self.process_set.cross_rank,
+                            size=self.process_set.cross_size)
 
     # -- public enqueue API -------------------------------------------------
     def enqueue(self, entry: TensorEntry) -> int:
@@ -195,12 +224,15 @@ class BackgroundRuntime:
     def stop(self):
         self._stop.set()
         self._wake.set()
+        if self.controller:
+            self.controller.stop()
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
-        for e in self.queue.finalize():
+        for e in list(self._pending.values()) + self.queue.finalize():
             self.handles.mark_done(
                 e.handle, exc=HorovodInternalError("Horovod has been shut down"))
+        self._pending.clear()
 
     # -- cycle ---------------------------------------------------------------
     def _loop(self):
@@ -228,11 +260,13 @@ class BackgroundRuntime:
                 for entry in batch:
                     self._finish(entry, None, e)
                 raise
+        if self.controller is not None:
+            batch = self._negotiate(batch)
+        elif self.process_set.cross_size > 1 and batch:
+            # no rendezvous store: best-effort deterministic order
+            batch.sort(key=lambda e: e.name)
         if not batch:
             return
-        # deterministic cross-process order (see module docstring)
-        if self.process_set.cross_size > 1:
-            batch.sort(key=lambda e: e.name)
         # split into fusable allreduce groups vs singletons
         fusable: dict[tuple, list[TensorEntry]] = {}
         singles: list[TensorEntry] = []
@@ -248,6 +282,38 @@ class BackgroundRuntime:
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
+
+    def _negotiate(self, batch: list[TensorEntry]) -> list[TensorEntry]:
+        """One negotiation round: post the pending set, receive the
+        globally-ready ordered list (reference ComputeResponseList slow
+        path, controller.cc:238-420). Runs every cycle — empty posts keep
+        the lockstep rounds advancing for ranks that have nothing pending.
+        """
+        from .controller import entry_signature
+
+        for e in batch:
+            self._pending[e.name] = e
+        sigs = {n: entry_signature(e) for n, e in self._pending.items()}
+        try:
+            ready, errors = self.controller.negotiate(sigs)
+        except Exception as exc:
+            # Fail everything — including on shutdown: a silent return would
+            # leak handles a caller may be blocked on in hvd.wait().
+            if self._stop.is_set():
+                err: Exception = HorovodInternalError("Horovod has been shut down")
+            else:
+                LOG.error("negotiation failed: %s", exc)
+                err = HorovodInternalError(
+                    f"controller negotiation failed: {exc}")
+            for e in self._pending.values():
+                self._finish(e, None, err)
+            self._pending.clear()
+            return []
+        for n, msg in errors.items():
+            e = self._pending.pop(n, None)
+            if e is not None:
+                self._finish(e, None, HorovodInternalError(msg))
+        return [self._pending.pop(n) for n in ready if n in self._pending]
 
     # -- execution -----------------------------------------------------------
     def _finish(self, entry: TensorEntry, result, exc=None):

@@ -1,0 +1,90 @@
+"""Host-list parsing and slot→rank assignment.
+
+Reference: /root/reference/horovod/runner/common/util/hosts.py — parse
+``-H host1:4,host2:4`` (or a hostfile), produce per-slot assignments with
+rank / local_rank / cross_rank triples (get_host_assignments, hosts.py:100).
+
+On TPU a "slot" is a worker *process* (driving local chips), so ``slots``
+usually equals the number of TPU processes per host (1 per VM), not chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class HostInfo:
+    hostname: str
+    slots: int
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+
+def parse_hosts(hosts_str: str) -> list[HostInfo]:
+    """Parse "host1:2,host2:4"; bare hostnames default to 1 slot."""
+    out = []
+    for part in hosts_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostInfo(name, int(slots)))
+        else:
+            out.append(HostInfo(part, 1))
+    return out
+
+
+def parse_hostfile(path: str) -> list[HostInfo]:
+    """mpirun-style hostfile: ``hostname slots=N`` per line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            out.append(HostInfo(parts[0], slots))
+    return out
+
+
+def get_host_assignments(hosts: list[HostInfo], np: int,
+                         min_np: Optional[int] = None) -> list[SlotInfo]:
+    """Assign np worker slots across hosts (reference hosts.py:100):
+    fill hosts in order; rank = global order, local_rank = index within
+    host, cross_rank = index of the host among used hosts."""
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        if min_np is not None and min_np <= total:
+            np = total
+        else:
+            raise ValueError(f"requested np={np} but only {total} slots available")
+    slots: list[SlotInfo] = []
+    rank = 0
+    cross = 0
+    for h in hosts:
+        if rank >= np:
+            break
+        use = min(h.slots, np - rank)
+        for lr in range(use):
+            slots.append(SlotInfo(h.hostname, rank, np, lr, use, cross, 0))
+            rank += 1
+        cross += 1
+    for s in slots:
+        s.cross_size = cross
+    return slots

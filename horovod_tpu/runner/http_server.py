@@ -1,0 +1,126 @@
+"""Rendezvous / notification HTTP key-value store.
+
+Reference: /root/reference/horovod/runner/http/http_server.py — a threaded
+BaseHTTPServer KV store with scopes; GET blocks until the key exists; the
+same class doubles as the elastic notification channel, and the C++
+HTTPStore (gloo_context) is its client.
+
+Same role here: the launcher starts one `RendezvousServer`; workers use
+`KVStoreClient` to publish addresses, fetch the coordinator endpoint for
+``jax.distributed.initialize``, and (multi-process eager mode) run the
+controller negotiation. Values are opaque bytes; keys are scoped
+``scope/key``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import unquote
+from urllib.request import Request, urlopen
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _key(self):
+        return unquote(self.path.lstrip("/"))
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        store = self.server.store  # type: ignore[attr-defined]
+        with store.cond:
+            store.data[self._key()] = body
+            store.cond.notify_all()
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        store = self.server.store  # type: ignore[attr-defined]
+        key = self._key()
+        timeout = float(self.headers.get("X-Timeout", "30"))
+        deadline = time.monotonic() + timeout
+        with store.cond:
+            while key not in store.data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                store.cond.wait(remaining)
+            body = store.data[key]
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_DELETE(self):
+        store = self.server.store  # type: ignore[attr-defined]
+        with store.cond:
+            prefix = self._key()
+            for k in [k for k in store.data if k.startswith(prefix)]:
+                del store.data[k]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class _Store:
+    def __init__(self):
+        self.data: dict[str, bytes] = {}
+        self.cond = threading.Condition()
+
+
+class RendezvousServer:
+    """Blocking-GET KV store over HTTP (reference RendezvousServer,
+    http_server.py:174)."""
+
+    def __init__(self, port: int = 0):
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._server.store = _Store()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="hvd-rendezvous")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._server.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class KVStoreClient:
+    """Client for RendezvousServer (role of the C++ HTTPStore,
+    gloo/http_store.cc:138)."""
+
+    def __init__(self, addr: str, port: int):
+        self.base = f"http://{addr}:{port}"
+
+    def put(self, scope: str, key: str, value: bytes):
+        req = Request(f"{self.base}/{scope}/{key}", data=value, method="PUT")
+        urlopen(req, timeout=30).read()
+
+    def get(self, scope: str, key: str, timeout: float = 30.0) -> bytes:
+        req = Request(f"{self.base}/{scope}/{key}", method="GET",
+                      headers={"X-Timeout": str(timeout)})
+        return urlopen(req, timeout=timeout + 10).read()
+
+    def delete_scope(self, scope: str):
+        req = Request(f"{self.base}/{scope}/", method="DELETE")
+        urlopen(req, timeout=30).read()

@@ -1,0 +1,73 @@
+"""Cross-process negotiation protocol (reference controller.cc semantics):
+out-of-order async submissions converge, not-everywhere-ready tensors wait,
+mismatched shapes produce per-tensor errors — driven end-to-end through
+hvdrun with 2 real processes."""
+
+import sys
+import textwrap
+
+from horovod_tpu.runner.launch import run_commandline
+
+WORKER = textwrap.dedent("""
+    import os, time
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    hvd.init()
+    r = hvd.cross_rank()
+    n = hvd.cross_size()
+    assert n == 2
+
+    # 1) different submission orders across ranks -> same results
+    names = [f"g{i}" for i in range(6)]
+    order = names if r == 0 else list(reversed(names))
+    handles = {}
+    for nm in order:
+        i = int(nm[1:])
+        handles[nm] = hvd.allreduce_async(
+            np.full((8,), float((r + 1) * (i + 1)), np.float32),
+            op=hvd.Sum, name=nm)
+    for nm in names:
+        i = int(nm[1:])
+        out = np.asarray(hvd.synchronize(handles[nm]))
+        expect = (i + 1) * sum(range(1, n + 1))
+        assert np.allclose(out, expect), (nm, out[0], expect)
+
+    # 2) a tensor only rank 0 submits stays pending until rank 1 joins
+    if r == 0:
+        h = hvd.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="late")
+        time.sleep(0.2)
+        assert not hvd.poll(h)  # still pending: rank 1 hasn't submitted
+    else:
+        time.sleep(0.5)
+        h = hvd.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="late")
+    out = np.asarray(hvd.synchronize(h))
+    assert np.allclose(out, 2.0), out
+
+    # 3) mismatched shape -> per-tensor error on both ranks
+    shape = (4,) if r == 0 else (5,)
+    h = hvd.allreduce_async(np.ones(shape, np.float32), op=hvd.Sum, name="bad")
+    try:
+        hvd.synchronize(h)
+        raise SystemExit("expected mismatch error")
+    except HorovodInternalError as e:
+        assert "Mismatched" in str(e) or "mismatch" in str(e).lower()
+
+    # 4) runtime still healthy after the error
+    out = np.asarray(hvd.synchronize(
+        hvd.allreduce_async(np.full((2,), float(r), np.float32),
+                            op=hvd.Sum, name="after")))
+    assert np.allclose(out, 1.0), out
+    print("controller OK", r)
+""")
+
+
+def test_negotiated_async_multiprocess(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0

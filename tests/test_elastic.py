@@ -1,0 +1,221 @@
+"""Elastic machinery — hermetic, mirroring the reference's
+test/single/test_elastic_driver.py style: scripted discovery, fake workers
+(no real cluster), state commit/restore/sync, the run-decorator retry
+loop, blacklist + stable assignment."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from horovod_tpu.elastic import (ElasticDriver, FixedHosts, HostManager,
+                                 JaxState, ObjectState)
+from horovod_tpu.elastic.driver import WorkerHandle
+
+
+# --- state -------------------------------------------------------------------
+
+def test_object_state_commit_restore():
+    s = ObjectState(epoch=0, items=[1, 2])
+    s.epoch = 5
+    s.items.append(3)
+    s.restore()  # nothing committed since init
+    assert s.epoch == 0 and s.items == [1, 2]
+    s.epoch = 7
+    s.commit()
+    s.epoch = 9
+    s.restore()
+    assert s.epoch == 7
+
+
+def test_jax_state_snapshots_to_host():
+    import jax.numpy as jnp
+
+    s = JaxState(params={"w": jnp.arange(4.0)}, step=0)
+    s.params = {"w": jnp.arange(4.0) * 2}
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), np.arange(4.0))
+
+
+def test_state_filesystem_store(tmp_path):
+    path = str(tmp_path / "state.pkl")
+    s1 = ObjectState(store_path=path, epoch=3)
+    s1.epoch = 4
+    s1.commit()
+    # a fresh process (simulated) resumes from the store automatically
+    s2 = ObjectState(store_path=path, epoch=0)
+    assert s2.epoch == 4
+
+
+def test_run_decorator_retries_on_internal_error():
+    calls = []
+
+    state = ObjectState(epoch=0)
+
+    @elastic.run
+    def train(st):
+        calls.append(st.epoch)
+        if len(calls) < 3:
+            st.epoch += 1
+            st.commit()
+            raise HorovodInternalError("collective failed")
+        return "done"
+
+    assert train(state) == "done"
+    # each retry restored the committed epoch then re-ran
+    assert len(calls) == 3
+
+
+def test_run_decorator_hosts_updated_keeps_state():
+    state = ObjectState(counter=0)
+    seen = []
+
+    @elastic.run
+    def train(st):
+        seen.append(st.counter)
+        if len(seen) == 1:
+            st.counter = 41
+            raise HostsUpdatedInterrupt(skip_sync=False)
+        return st.counter + 1
+
+    assert train(state) == 42  # counter kept (no restore) across interrupt
+
+
+# --- discovery / host manager ------------------------------------------------
+
+def test_host_manager_blacklist_and_change_detection():
+    disc = FixedHosts({"a": 2, "b": 2})
+    hm = HostManager(disc)
+    assert hm.update_available_hosts() is True  # {} -> {a,b}
+    assert hm.available_slots() == 4
+    hm.blacklist("b")
+    assert hm.current_hosts == {"a": 2}
+    disc.set({"a": 2, "b": 2, "c": 2})
+    assert hm.update_available_hosts() is True
+    assert hm.current_hosts == {"a": 2, "c": 2}  # b stays blacklisted
+    assert hm.update_available_hosts() is False  # no change
+
+
+# --- driver with fake workers ------------------------------------------------
+
+class FakeWorker(WorkerHandle):
+    """Thread-free worker stub: exit code is set by the test scenario."""
+
+    def __init__(self):
+        self._rc = None
+        self.terminated = False
+
+    def finish(self, rc: int):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self.terminated = True
+        self._rc = -15
+
+
+class Scenario:
+    def __init__(self):
+        self.launched = []  # list of (round, slot)
+        self.workers = []
+
+    def create(self, slot, env):
+        w = FakeWorker()
+        self.launched.append((slot.hostname, slot.rank, env["HOROVOD_ELASTIC_EPOCH"]))
+        self.workers.append((slot, w))
+        return w
+
+
+def run_driver_async(driver, scenario):
+    result = {}
+
+    def go():
+        result["rc"] = driver.run(scenario.create, lambda s: {})
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t, result
+
+
+def wait_for(pred, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_driver_all_success():
+    disc = FixedHosts({"a": 2})
+    driver = ElasticDriver(disc, min_np=1)
+    sc = Scenario()
+    t, result = run_driver_async(driver, sc)
+    assert wait_for(lambda: len(sc.workers) == 2)
+    for _, w in sc.workers:
+        w.finish(0)
+    t.join(timeout=10)
+    assert result["rc"] == 0
+    driver.stop()
+
+
+def test_driver_blacklists_failed_host_and_restarts():
+    disc = FixedHosts({"a": 1, "b": 1})
+    driver = ElasticDriver(disc, min_np=1)
+    sc = Scenario()
+    t, result = run_driver_async(driver, sc)
+    assert wait_for(lambda: len(sc.workers) == 2)
+    # worker on host b fails
+    for slot, w in sc.workers:
+        if slot.hostname == "b":
+            w.finish(1)
+    # a new round launches only on host a
+    assert wait_for(lambda: len(sc.workers) == 3)
+    assert driver.host_manager.is_blacklisted("b")
+    round2 = sc.workers[2:]
+    assert all(s.hostname == "a" for s, _ in round2)
+    assert all(s.size == 1 for s, _ in round2)
+    for _, w in round2:
+        w.finish(0)
+    t.join(timeout=10)
+    assert result["rc"] == 0
+    driver.stop()
+
+
+def test_driver_membership_change_triggers_new_round():
+    disc = FixedHosts({"a": 1})
+    driver = ElasticDriver(disc, min_np=1, max_np=4)
+    sc = Scenario()
+    t, result = run_driver_async(driver, sc)
+    assert wait_for(lambda: len(sc.workers) == 1)
+    disc.set({"a": 1, "b": 1})  # scale up
+    assert wait_for(lambda: len(sc.workers) == 3)  # old terminated, 2 new
+    assert sc.workers[0][1].terminated
+    round2 = sc.workers[1:]
+    # stable assignment: surviving host 'a' keeps rank 0
+    assert [s.hostname for s, _ in round2] == ["a", "b"]
+    epochs = {e for _, _, e in sc.launched}
+    assert len(epochs) == 2  # epoch bumped
+    for _, w in round2:
+        w.finish(0)
+    t.join(timeout=10)
+    assert result["rc"] == 0
+    driver.stop()
+
+
+def test_driver_min_np_violation_fails():
+    disc = FixedHosts({"a": 1})
+    driver = ElasticDriver(disc, min_np=1)
+    sc = Scenario()
+    t, result = run_driver_async(driver, sc)
+    assert wait_for(lambda: len(sc.workers) == 1)
+    sc.workers[0][1].finish(2)  # fail -> blacklist only host -> below min_np
+    t.join(timeout=10)
+    assert result["rc"] == 1
+    driver.stop()
