@@ -1,0 +1,80 @@
+"""PyTorch MNIST with horovod_tpu.torch — mirrors the reference's
+examples/pytorch/pytorch_mnist.py structure (BASELINE.md tracked config 1):
+DistributedOptimizer + broadcast_parameters/optimizer_state, per-rank data
+sharding, rank-0 logging. Synthetic data in zero-egress environments."""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--use-adasum", action="store_true")
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    rng = np.random.RandomState(0)
+    x = torch.tensor(rng.rand(2048, 1, 28, 28), dtype=torch.float32)
+    y = torch.tensor((rng.rand(2048) * 10), dtype=torch.long) % 10
+    # per-process shard (reference: DistributedSampler(num_replicas=size,
+    # rank=rank))
+    x = x[hvd.cross_rank()::hvd.cross_size()]
+    y = y[hvd.cross_rank()::hvd.cross_size()]
+
+    model = Net()
+    lr_scaler = hvd.size() if not args.use_adasum else 1
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * lr_scaler,
+                                momentum=0.5)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    for epoch in range(args.epochs):
+        model.train()
+        perm = torch.randperm(len(x))
+        for i in range(0, len(x) - args.batch_size, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x[idx]), y[idx])
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Training-loop callbacks — the Keras-callback surface, JAX-shaped.
+
+Reference: /root/reference/horovod/_keras/callbacks.py +
+keras/callbacks.py — `BroadcastGlobalVariablesCallback`,
+`MetricAverageCallback`, `LearningRateWarmupCallback`,
+`LearningRateScheduleCallback`, elastic `CommitStateCallback` /
+`UpdateBatchStateCallback`.
+
+JAX training loops are explicit, so these are small callables invoked from
+the loop (flax has no global callback registry); each documents the
+reference callback it replaces. The LR schedules are optax-composable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+import optax
+
+from . import broadcast_parameters
+from .ops import collectives as C
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast params (+opt state) from root once, at train start
+    (reference keras/callbacks.py BroadcastGlobalVariablesCallback)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def __call__(self, params, opt_state=None):
+        if self._done:
+            return (params, opt_state) if opt_state is not None else params
+        self._done = True
+        params = broadcast_parameters(params, self.root_rank)
+        if opt_state is not None:
+            opt_state = jax.tree.map(
+                lambda x: C.broadcast(x, self.root_rank)
+                if hasattr(x, "dtype") else x, opt_state)
+            return params, opt_state
+        return params
+
+
+class MetricAverageCallback:
+    """Average epoch metrics across workers before logging (reference
+    MetricAverageCallback: allreduce of logs at epoch end)."""
+
+    def __call__(self, metrics: dict) -> dict:
+        out = {}
+        for k, v in metrics.items():
+            out[k] = float(np.asarray(
+                C.allreduce(np.asarray(v, np.float32), average=True)))
+        return out
+
+
+def warmup_schedule(base_lr: float, size: Optional[int] = None,
+                    warmup_epochs: float = 5.0,
+                    steps_per_epoch: int = 1,
+                    initial_lr_scale: Optional[float] = None) -> optax.Schedule:
+    """LR warmup from lr to lr*size over warmup_epochs (reference
+    LearningRateWarmupCallback: 'gradual warmup' from the one-hour
+    ImageNet recipe). Compose with optax:
+
+        optax.sgd(learning_rate=hvd.callbacks.warmup_schedule(0.1))
+    """
+    from .common import context as ctx_mod
+
+    n = size if size is not None else (
+        ctx_mod.size() if ctx_mod.is_initialized() else 1)
+    start = base_lr * (initial_lr_scale if initial_lr_scale is not None else 1.0)
+    peak = base_lr * n
+    warmup_steps = max(1, int(warmup_epochs * steps_per_epoch))
+    return optax.linear_schedule(start, peak, warmup_steps)
+
+
+def multiplier_schedule(base_lr: float,
+                        multipliers: list[tuple[int, float]],
+                        steps_per_epoch: int = 1) -> optax.Schedule:
+    """Piecewise-constant multiplier schedule (reference
+    LearningRateScheduleCallback: multiplier per epoch range).
+
+    ``multipliers`` = [(start_epoch, multiplier), ...] sorted ascending.
+    """
+    boundaries = {int(e * steps_per_epoch): m for e, m in multipliers}
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        lr = jnp.asarray(base_lr)
+        for boundary, mult in sorted(boundaries.items()):
+            lr = jnp.where(step >= boundary, base_lr * mult, lr)
+        return lr
+
+    return schedule
+
+
+class CommitStateCallback:
+    """Commit elastic state every N batches (reference elastic
+    CommitStateCallback)."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        self.state = state
+        self.n = batches_per_commit
+        self._i = 0
+
+    def __call__(self):
+        self._i += 1
+        if self._i % self.n == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback:
+    """Track batch progress in elastic state so resumed epochs continue
+    mid-epoch (reference UpdateBatchStateCallback)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def __call__(self, batch: int):
+        self.state.batch = batch
+
+    def end_epoch(self):
+        self.state.batch = 0

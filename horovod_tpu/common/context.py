@@ -246,6 +246,7 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 from ..utils.autotune import Autotuner
 
                 _ctx.autotuner = Autotuner(_ctx.runtime, log_path=_ctx.config.autotune_log)
+                _ctx.runtime.autotuner = _ctx.autotuner
         _ctx.initialized = True
         LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
 

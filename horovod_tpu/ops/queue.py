@@ -177,6 +177,8 @@ class BackgroundRuntime:
         # is bytes/sec, parameter_manager.h:88)
         self.bytes_processed = 0
         self.cycles = 0
+        self.work_cycles = 0
+        self.autotuner = None  # attached by context.init when HOROVOD_AUTOTUNE
         self.controller = self._maybe_controller()
 
     def _maybe_controller(self):
@@ -282,6 +284,14 @@ class BackgroundRuntime:
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
+        # autotune sampling on working cycles (reference: ParameterManager
+        # scores each cycle's bytes/sec, parameter_manager.h:88)
+        self.work_cycles += 1
+        if self.autotuner is not None and self.work_cycles % 20 == 0:
+            try:
+                self.autotuner.sample()
+            except Exception:
+                LOG.exception("autotune sample failed")
 
     def _negotiate(self, batch: list[TensorEntry]) -> list[TensorEntry]:
         """One negotiation round: post the pending set, receive the

@@ -1,0 +1,66 @@
+"""Cross-chip synchronized batch normalization.
+
+Reference: /root/reference/horovod/torch/sync_batch_norm.py (199 LoC:
+allgather of per-rank counts/means/vars, hand-written backward) and
+tensorflow/sync_batch_norm.py.
+
+TPU-native: flax's BatchNorm already accepts ``axis_name`` and computes
+cross-chip statistics with a psum — differentiable by construction, no
+hand-written backward needed. This module provides (a) the Horovod-named
+wrapper and (b) `sync_batch_stats`, the functional primitive for custom
+training loops that track running statistics per chip and fold them at
+checkpoint time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..common.context import DEFAULT_AXIS
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose batch statistics are computed over the
+    global batch (all chips on ``axis_name``)."""
+
+    axis_name: str = DEFAULT_AXIS
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        return nn.BatchNorm(
+            use_running_average=nn.merge_param(
+                "use_running_average", self.use_running_average,
+                use_running_average),
+            momentum=self.momentum, epsilon=self.epsilon, dtype=self.dtype,
+            axis_name=self.axis_name, name="sync_bn")(x)
+
+
+def sync_batch_stats(batch_stats, axis_name: str = DEFAULT_AXIS):
+    """Average running BN statistics across chips (the conventional
+    pre-checkpoint fold for per-chip BN — reference users call
+    broadcast_variables; with per-chip stats the mean is the standard
+    estimator)."""
+    return jax.tree.map(lambda s: jax.lax.pmean(s, axis_name), batch_stats)
+
+
+def moments_sync(x, axis_name: str = DEFAULT_AXIS, axes=(0,)):
+    """Cross-chip mean/variance of ``x`` over ``axes`` + the chip axis —
+    the core computation of the reference's _sync_batch_norm forward,
+    expressed as two psums (count-weighted)."""
+    n_local = 1
+    for a in axes:
+        n_local *= x.shape[a]
+    n = jax.lax.psum(jnp.asarray(n_local, jnp.float32), axis_name)
+    s1 = jax.lax.psum(jnp.sum(x, axis=axes), axis_name)
+    s2 = jax.lax.psum(jnp.sum(x * x, axis=axes), axis_name)
+    mean = s1 / n
+    var = s2 / n - mean * mean
+    return mean, var

@@ -1,1 +1,363 @@
+"""horovod_tpu.torch — the PyTorch-facing API (reference horovod.torch).
 
+Mirrors /root/reference/horovod/torch/mpi_ops.py (sync + ``*_async`` +
+in-place variants, poll/synchronize handles), optimizer.py
+(`DistributedOptimizer` with per-parameter gradient hooks,
+``backward_passes_per_step``, ``skip_synchronize``), functions.py
+(`broadcast_parameters`, `broadcast_optimizer_state`) and elastic
+TorchState — implemented over the horovod_tpu eager runtime, so torch
+scripts negotiate/fuse/execute through the same controller and cycle loop
+as everything else. Tensors cross the boundary as host numpy (torch CPU
+build; the collective itself runs on the TPU data plane).
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(optimizer,
+                                         named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+from typing import Optional
+
+import numpy as np
+import torch
+
+import horovod_tpu as _core
+import horovod_tpu.elastic as elastic  # noqa: F401
+from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
+    Adasum,
+    Average,
+    ReduceOp,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
+from horovod_tpu.elastic.state import ObjectState
+
+
+class Compression:
+    """fp16-on-the-wire compression (reference torch/compression.py)."""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype in (torch.float32, torch.float64):
+                return t.half(), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t.to(ctx) if ctx is not None else t
+
+
+# handle -> (in-place target or None, caller dtype to restore).
+# JAX runs with x64 disabled (TPUs have no f64 ALUs), so float64/int64 ride
+# the wire as 32-bit; the shim restores the torch dtype on the way out —
+# documented precision difference vs the reference's MPI_DOUBLE path.
+_handle_meta: dict[int, tuple[Optional[torch.Tensor], Optional[torch.dtype]]] = {}
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _result_tensor(handle: int, result) -> torch.Tensor:
+    target, dtype = _handle_meta.pop(handle, (None, None))
+    out = torch.from_numpy(np.ascontiguousarray(np.asarray(result)))
+    if target is not None:
+        target.copy_(out.to(target.dtype).reshape(target.shape))
+        return target
+    return out.to(dtype) if dtype is not None else out
+
+
+# --- async ops (reference mpi_ops.py:95-560) --------------------------------
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    h = _core.allreduce_async(_to_np(tensor), average, name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor)
+    _handle_meta[h] = (None, tensor.dtype)
+    return h
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    h = _core.allreduce_async(_to_np(tensor), average, name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor)
+    _handle_meta[h] = (tensor, tensor.dtype)
+    return h
+
+
+def allgather_async(tensor, name=None) -> int:
+    h = _core.allgather_async(_to_np(tensor), name)
+    _handle_meta[h] = (None, tensor.dtype)
+    return h
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    h = _core.broadcast_async(_to_np(tensor), root_rank, name)
+    _handle_meta[h] = (None, tensor.dtype)
+    return h
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    h = _core.broadcast_async(_to_np(tensor), root_rank, name)
+    _handle_meta[h] = (tensor, tensor.dtype)
+    return h
+
+
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    h = _core.alltoall_async(_to_np(tensor),
+                             None if splits is None else _to_np(splits), name)
+    _handle_meta[h] = (None, tensor.dtype)
+    return h
+
+
+def poll(handle: int) -> bool:
+    return _core.poll(handle)
+
+
+def synchronize(handle: int):
+    result = _core.synchronize(handle)
+    if isinstance(result, tuple):  # alltoall returns (output, recv_splits)
+        out, splits = result
+        _handle_meta.pop(handle, None)
+        return (torch.from_numpy(np.ascontiguousarray(np.asarray(out))),
+                torch.from_numpy(np.ascontiguousarray(np.asarray(splits))))
+    return _result_tensor(handle, result)
+
+
+# --- sync wrappers ----------------------------------------------------------
+
+def allreduce(tensor, average=None, name=None, op=None,
+              compression=Compression.none,
+              prescale_factor=1.0, postscale_factor=1.0):
+    t, ctx = compression.compress(tensor)
+    out = synchronize(allreduce_async(t, average, name, op, prescale_factor,
+                                      postscale_factor))
+    return compression.decompress(out, ctx)
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor))
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall(tensor, splits=None, name=None):
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+def sparse_allreduce_async(tensor, name, op=Average):
+    """Sparse COO reduction via allgather of values+indices (reference
+    torch/mpi_ops.py:512). Returns a thunk that completes the op."""
+    t = tensor.coalesce()
+    hi = allgather_async(t.indices().t().contiguous(), f"{name}.indices")
+    hv = allgather_async(t.values(), f"{name}.values")
+
+    def finish():
+        indices = synchronize(hi).t()
+        values = synchronize(hv)
+        if op == Average:
+            # eager collectives contribute per *process* (cross_size), not
+            # per chip — divide by the actual number of contributors
+            values = values / cross_size()
+        return torch.sparse_coo_tensor(indices, values, t.shape).coalesce()
+
+    return finish
+
+
+def join() -> int:
+    return _core.join()
+
+
+def barrier():
+    _core.barrier()
+
+
+# --- parameter/optimizer broadcast (reference torch/functions.py) -----------
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Accepts a state_dict or an iterable of (name, tensor)
+    (reference functions.py:29)."""
+    items = sorted(params.items()) if isinstance(params, dict) \
+        else sorted(dict(params).items())
+    handles = [broadcast_async_(p.data, root_rank, f"bcast.{name}")
+               for name, p in items if isinstance(p, torch.Tensor)]
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0):
+    """Broadcast full optimizer state from root (reference
+    functions.py:61; pickle path covers non-tensor entries)."""
+    state = _core.broadcast_object(optimizer.state_dict(), root_rank=root_rank)
+    optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None):
+    return _core.broadcast_object(obj, root_rank=root_rank)
+
+
+def allgather_object(obj, name=None):
+    return _core.allgather_object(obj)
+
+
+# --- DistributedOptimizer (reference torch/optimizer.py) --------------------
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer; per-parameter post-accumulate hooks launch
+    async allreduces, step() synchronizes (reference optimizer.py:35,
+    hooks :219-247, synchronize :249-286)."""
+
+    def __init__(self, inner: torch.optim.Optimizer, named_parameters,
+                 compression, op, backward_passes_per_step,
+                 prescale_factor, postscale_factor):
+        self._inner = inner
+        self._compression = compression
+        self._op = op
+        self._bpps = backward_passes_per_step
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._handles: dict[torch.Tensor, tuple[int, object]] = {}
+        self._passes: dict[torch.Tensor, int] = {}
+        self._should_sync = True
+        self._hook_handles = []
+        if named_parameters is not None:
+            names = {p: n for n, p in named_parameters}
+        else:
+            names = {}
+            for gi, group in enumerate(inner.param_groups):
+                for pi, p in enumerate(group["params"]):
+                    names[p] = f"allreduce.noname.{gi}.{pi}"
+        self._names = names
+        for p in names:
+            if p.requires_grad:
+                self._passes[p] = 0
+                self._hook_handles.append(
+                    p.register_post_accumulate_grad_hook(self._hook))
+
+    # hook fired when a parameter's gradient is fully accumulated
+    def _hook(self, p):
+        self._passes[p] += 1
+        if self._passes[p] < self._bpps:
+            return
+        self._passes[p] = 0
+        grad = p.grad
+        if self._bpps > 1:
+            grad = grad / self._bpps
+        comp, ctx = self._compression.compress(grad)
+        h = allreduce_async(comp, name=self._names[p], op=self._op,
+                            prescale_factor=self._prescale,
+                            postscale_factor=self._postscale)
+        self._handles[p] = (h, ctx)
+
+    def synchronize(self):
+        for p, (h, ctx) in list(self._handles.items()):
+            reduced = synchronize(h)
+            p.grad = self._compression.decompress(
+                reduced, ctx).reshape(p.grad.shape).to(p.grad.dtype)
+        self._handles.clear()
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """reference optimizer.py skip_synchronize: suppress the implicit
+        synchronize in the next step() (used with gradient clipping after a
+        manual synchronize())."""
+        self._should_sync = False
+        try:
+            yield
+        finally:
+            self._should_sync = True
+
+    def step(self, closure=None):
+        if self._should_sync:
+            self.synchronize()
+        return self._inner.step(closure)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         op=Average,
+                         backward_passes_per_step: int = 1,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0):
+    named = list(named_parameters) if named_parameters is not None else None
+    return _DistributedOptimizer(optimizer, named, compression, op,
+                                 backward_passes_per_step,
+                                 prescale_factor, postscale_factor)
+
+
+# --- elastic TorchState (reference torch/elastic/state.py) ------------------
+
+class TorchState(ObjectState):
+    """Elastic state with torch model/optimizer handlers: snapshots are cpu
+    clones of state_dicts; sync broadcasts from rank 0."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._model_saved = None
+        self._opt_saved = None
+        super().__init__(**kwargs)
+
+    def save(self):
+        if self._model is not None:
+            self._model_saved = {k: v.detach().clone()
+                                 for k, v in self._model.state_dict().items()}
+        if self._optimizer is not None:
+            self._opt_saved = copy.deepcopy(self._optimizer.state_dict())
+        super().save()
+
+    def restore(self):
+        if self._model_saved is not None:
+            self._model.load_state_dict(self._model_saved)
+        if self._opt_saved is not None:
+            self._optimizer.load_state_dict(self._opt_saved)
+        super().restore()
+
+    def sync(self):
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(), root_rank=0)
+        if self._optimizer is not None:
+            broadcast_optimizer_state(self._optimizer, root_rank=0)
+        super().sync()

@@ -1,0 +1,132 @@
+"""horovod_tpu.torch API surface (reference test/parallel/test_torch.py
+patterns, single-process semantics + hook-driven optimizer mechanics)."""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def test_allreduce_roundtrip_dtypes():
+    for dtype in (torch.float32, torch.float64, torch.int32):
+        t = torch.arange(8, dtype=dtype)
+        out = hvd.allreduce(t, op=hvd.Sum, name=f"t.torch.{dtype}")
+        assert torch.equal(out, t)
+        assert out.dtype == dtype
+
+
+def test_allreduce_inplace_and_average():
+    t = torch.ones(4) * 3
+    out = hvd.allreduce_(t, average=True, name="t.torch.inplace")
+    assert out is t
+    assert torch.allclose(t, torch.ones(4) * 3)
+
+
+def test_allreduce_fp16_compression():
+    t = torch.randn(16)
+    out = hvd.allreduce(t, average=True, name="t.torch.fp16",
+                        compression=hvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, t, atol=1e-2)
+
+
+def test_allgather_broadcast_alltoall():
+    t = torch.arange(6, dtype=torch.float32).reshape(3, 2)
+    assert torch.equal(hvd.allgather(t, name="t.torch.ag"), t)
+    assert torch.equal(hvd.broadcast(t, 0, name="t.torch.bc"), t)
+    out, splits = hvd.alltoall(torch.arange(4.0), name="t.torch.a2a")
+    assert torch.equal(out, torch.arange(4.0))
+
+
+def test_poll_synchronize_handles():
+    h = hvd.allreduce_async(torch.ones(4), name="t.torch.async")
+    out = hvd.synchronize(h)
+    assert torch.equal(out, torch.ones(4))
+
+
+def test_broadcast_parameters_and_optimizer_state():
+    model = torch.nn.Linear(4, 2)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    model(torch.randn(2, 4)).sum().backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_distributed_optimizer_trains():
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                                torch.nn.Linear(16, 1))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.Adam(model.parameters(), lr=1e-2),
+        named_parameters=model.named_parameters())
+    x = torch.randn(64, 8)
+    w = torch.randn(8, 1)
+    y = x @ w
+    losses = []
+    for _ in range(50):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()  # hooks launch async allreduces
+        opt.step()       # synchronizes + inner step
+        losses.append(float(loss))
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+def test_distributed_optimizer_backward_passes_per_step():
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    # two backward passes accumulate before one reduced update
+    out1 = model(torch.ones(1, 2)).sum()
+    out1.backward()
+    assert not opt._handles  # no reduction launched yet
+    out2 = model(torch.ones(1, 2) * 3).sum()
+    out2.backward()
+    assert opt._handles  # second pass triggered the allreduce
+    opt.step()
+    # grad = (1+3)/2 per input element = 2 -> w = 1 - 2 = -1
+    assert torch.allclose(model.weight.data, torch.full((1, 2), -1.0))
+
+
+def test_skip_synchronize():
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.5),
+        named_parameters=model.named_parameters())
+    model(torch.ones(1, 2)).sum().backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+    with opt.skip_synchronize():
+        opt.step()
+
+
+def test_sparse_allreduce():
+    i = torch.tensor([[0, 2], [1, 0]])
+    v = torch.tensor([3.0, 4.0])
+    t = torch.sparse_coo_tensor(i, v, (3, 2))
+    finish = hvd.sparse_allreduce_async(t, name="t.torch.sparse")
+    out = finish().to_dense()
+    assert float(out[0, 1]) == 3.0 and float(out[2, 0]) == 4.0
+
+
+def test_torch_state_commit_restore():
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = hvd.TorchState(model=model, optimizer=opt, epoch=1)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    state.commit()
+    with torch.no_grad():
+        for p in model.parameters():
+            p.mul_(5.0)
+    state.epoch = 9
+    state.restore()
+    after = model.state_dict()
+    for k in before:
+        assert torch.equal(before[k], after[k])
+    assert state.epoch == 1
