@@ -50,7 +50,9 @@ def main():
     y = y[hvd.cross_rank()::hvd.cross_size()]
 
     model = Net()
-    lr_scaler = hvd.size() if not args.use_adasum else 1
+    # linear LR scaling by the number of gradient contributors: the eager
+    # torch path averages per *process* (cross_size), not per chip
+    lr_scaler = hvd.cross_size() if not args.use_adasum else 1
     optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * lr_scaler,
                                 momentum=0.5)
     compression = (hvd.Compression.fp16 if args.fp16_allreduce
@@ -66,13 +68,14 @@ def main():
     for epoch in range(args.epochs):
         model.train()
         perm = torch.randperm(len(x))
-        for i in range(0, len(x) - args.batch_size, args.batch_size):
+        loss = None
+        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
             idx = perm[i:i + args.batch_size]
             optimizer.zero_grad()
             loss = F.nll_loss(model(x[idx]), y[idx])
             loss.backward()
             optimizer.step()
-        if hvd.rank() == 0:
+        if hvd.rank() == 0 and loss is not None:
             print(f"epoch {epoch}: loss={float(loss):.4f}")
 
 

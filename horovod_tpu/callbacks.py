@@ -34,14 +34,15 @@ class BroadcastGlobalVariablesCallback:
     def __call__(self, params, opt_state=None):
         if self._done:
             return (params, opt_state) if opt_state is not None else params
-        self._done = True
         params = broadcast_parameters(params, self.root_rank)
         if opt_state is not None:
             opt_state = jax.tree.map(
                 lambda x: C.broadcast(x, self.root_rank)
                 if hasattr(x, "dtype") else x, opt_state)
-            return params, opt_state
-        return params
+        # only latch after the broadcast succeeded — a failed first call
+        # must not silently disable synchronization on retry
+        self._done = True
+        return (params, opt_state) if opt_state is not None else params
 
 
 class MetricAverageCallback:

@@ -46,7 +46,7 @@ def sparse_allreduce(slices: IndexedSlices, *, average: bool = True,
     Returns gathered slices; duplicate indices are legal (consumers apply
     scatter-add), matching IndexedSlices semantics.
     """
-    if isinstance(slices.values, jax.core.Tracer):
+    if C._is_traced(slices.values):
         n = lax.axis_size(axis_name)
         values = C._traced_allgather(slices.values, axis_name)
         indices = C._traced_allgather(slices.indices, axis_name)

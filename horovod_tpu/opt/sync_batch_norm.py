@@ -47,8 +47,17 @@ def sync_batch_stats(batch_stats, axis_name: str = DEFAULT_AXIS):
     """Average running BN statistics across chips (the conventional
     pre-checkpoint fold for per-chip BN — reference users call
     broadcast_variables; with per-chip stats the mean is the standard
-    estimator)."""
-    return jax.tree.map(lambda s: jax.lax.pmean(s, axis_name), batch_stats)
+    estimator). Works both inside a traced step (pmean over the mesh
+    axis) and eagerly on concrete arrays at checkpoint time (dispatches
+    to the eager process collectives like every other collective)."""
+    from ..ops import collectives as C
+
+    def _avg(s):
+        if C._is_traced(s):
+            return jax.lax.pmean(s, axis_name)
+        return C.allreduce(s, average=True)
+
+    return jax.tree.map(_avg, batch_stats)
 
 
 def moments_sync(x, axis_name: str = DEFAULT_AXIS, axes=(0,)):

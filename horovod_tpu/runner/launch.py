@@ -48,6 +48,15 @@ def slot_env(slot: SlotInfo, rendezvous_addr: str, rendezvous_port: int,
     """Per-slot env injection (reference gloo_run.py:65
     create_slot_env_vars + gloo_context.cc:136-192 consumption)."""
     e = dict(os.environ)
+    # Workers must be able to import horovod_tpu even when the launcher runs
+    # from a source checkout (python adds the *script* dir to sys.path, not
+    # the launcher's cwd) — prepend our own import root.
+    import horovod_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(horovod_tpu.__file__)))
+    pythonpath = e.get("PYTHONPATH", "")
+    if pkg_root not in pythonpath.split(os.pathsep):
+        e["PYTHONPATH"] = (pkg_root + os.pathsep + pythonpath).rstrip(os.pathsep)
     e.update({
         env_schema.HOROVOD_RANK: str(slot.rank),
         env_schema.HOROVOD_SIZE: str(slot.size),
@@ -293,3 +302,7 @@ def run(fn, args=(), kwargs=None, np: int = 1, extra_env: Optional[dict] = None)
         if rc != 0:
             raise RuntimeError(f"hvdrun job failed with exit code {rc}")
         return [pickle.load(open(out_tpl.format(rank=r), "rb")) for r in range(np)]
+
+
+if __name__ == "__main__":
+    main()

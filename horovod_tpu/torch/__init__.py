@@ -82,13 +82,21 @@ def _to_np(t: torch.Tensor) -> np.ndarray:
     return t.detach().cpu().numpy()
 
 
+def _np_to_torch(result, dtype=None) -> torch.Tensor:
+    # np.array (not asarray): collective results can be read-only views of
+    # the runtime's shared fused buffer — hand the caller a writable copy so
+    # in-place use (grad mutation, zero_grad) can't corrupt neighbors.
+    out = torch.from_numpy(np.array(result))
+    return out.to(dtype) if dtype is not None else out
+
+
 def _result_tensor(handle: int, result) -> torch.Tensor:
     target, dtype = _handle_meta.pop(handle, (None, None))
-    out = torch.from_numpy(np.ascontiguousarray(np.asarray(result)))
     if target is not None:
+        out = torch.from_numpy(np.asarray(result))
         target.copy_(out.to(target.dtype).reshape(target.shape))
         return target
-    return out.to(dtype) if dtype is not None else out
+    return _np_to_torch(result, dtype)
 
 
 # --- async ops (reference mpi_ops.py:95-560) --------------------------------
@@ -141,12 +149,18 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
-    result = _core.synchronize(handle)
+    try:
+        result = _core.synchronize(handle)
+    except Exception:
+        # drop the meta entry even on failure (elastic reset raises
+        # HorovodInternalError for every in-flight handle) so in-place
+        # targets aren't pinned forever
+        _handle_meta.pop(handle, None)
+        raise
     if isinstance(result, tuple):  # alltoall returns (output, recv_splits)
         out, splits = result
-        _handle_meta.pop(handle, None)
-        return (torch.from_numpy(np.ascontiguousarray(np.asarray(out))),
-                torch.from_numpy(np.ascontiguousarray(np.asarray(splits))))
+        _, dtype = _handle_meta.pop(handle, (None, None))
+        return _np_to_torch(out, dtype), _np_to_torch(splits)
     return _result_tensor(handle, result)
 
 
@@ -240,15 +254,18 @@ def allgather_object(obj, name=None):
 
 # --- DistributedOptimizer (reference torch/optimizer.py) --------------------
 
-class _DistributedOptimizer:
-    """Wraps a torch optimizer; per-parameter post-accumulate hooks launch
-    async allreduces, step() synchronizes (reference optimizer.py:35,
-    hooks :219-247, synchronize :249-286)."""
+class _DistributedMixin:
+    """Methods grafted onto the wrapped optimizer's own class: per-parameter
+    post-accumulate hooks launch async allreduces, step() synchronizes
+    (reference optimizer.py:35, hooks :219-247, synchronize :249-286).
+    The reference dynamically subclasses the wrapped optimizer's class so
+    isinstance-based integrations (LR schedulers, GradScaler, Lightning)
+    accept the result; we do the same by swapping ``__class__`` in place,
+    which additionally preserves existing optimizer state."""
 
-    def __init__(self, inner: torch.optim.Optimizer, named_parameters,
-                 compression, op, backward_passes_per_step,
-                 prescale_factor, postscale_factor):
-        self._inner = inner
+    def _hvd_setup(self, named_parameters, compression, op,
+                   backward_passes_per_step, prescale_factor,
+                   postscale_factor):
         self._compression = compression
         self._op = op
         self._bpps = backward_passes_per_step
@@ -260,9 +277,18 @@ class _DistributedOptimizer:
         self._hook_handles = []
         if named_parameters is not None:
             names = {p: n for n, p in named_parameters}
+            all_params = {p for g in self.param_groups for p in g["params"]}
+            missing = all_params - names.keys()
+            if missing:
+                # reference optimizer.py raises when named_parameters does
+                # not cover the optimizer — uncovered params would silently
+                # skip reduction and diverge across workers
+                raise ValueError(
+                    "named_parameters does not cover all optimizer "
+                    f"parameters ({len(missing)} uncovered)")
         else:
             names = {}
-            for gi, group in enumerate(inner.param_groups):
+            for gi, group in enumerate(self.param_groups):
                 for pi, p in enumerate(group["params"]):
                     names[p] = f"allreduce.noname.{gi}.{pi}"
         self._names = names
@@ -272,16 +298,15 @@ class _DistributedOptimizer:
                 self._hook_handles.append(
                     p.register_post_accumulate_grad_hook(self._hook))
 
-    # hook fired when a parameter's gradient is fully accumulated
+    # hook fired when a parameter's gradient is fully accumulated.
+    # With backward_passes_per_step > 1 the *accumulated sum* is allreduced
+    # unscaled, matching the reference semantics (optimizer.py:219-247).
     def _hook(self, p):
         self._passes[p] += 1
         if self._passes[p] < self._bpps:
             return
         self._passes[p] = 0
-        grad = p.grad
-        if self._bpps > 1:
-            grad = grad / self._bpps
-        comp, ctx = self._compression.compress(grad)
+        comp, ctx = self._compression.compress(p.grad)
         h = allreduce_async(comp, name=self._names[p], op=self._op,
                             prescale_factor=self._prescale,
                             postscale_factor=self._postscale)
@@ -308,10 +333,7 @@ class _DistributedOptimizer:
     def step(self, closure=None):
         if self._should_sync:
             self.synchronize()
-        return self._inner.step(closure)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
+        return self._hvd_base.step(self, closure)
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -321,10 +343,16 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0):
-    named = list(named_parameters) if named_parameters is not None else None
-    return _DistributedOptimizer(optimizer, named, compression, op,
-                                 backward_passes_per_step,
-                                 prescale_factor, postscale_factor)
+    base = optimizer.__class__
+    body = {k: v for k, v in _DistributedMixin.__dict__.items()
+            if not k.startswith("__")}
+    body["_hvd_base"] = base
+    optimizer.__class__ = type("Distributed" + base.__name__, (base,), body)
+    optimizer._hvd_setup(
+        list(named_parameters) if named_parameters is not None else None,
+        compression, op, backward_passes_per_step,
+        prescale_factor, postscale_factor)
+    return optimizer
 
 
 # --- elastic TorchState (reference torch/elastic/state.py) ------------------

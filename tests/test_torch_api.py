@@ -90,8 +90,12 @@ def test_distributed_optimizer_backward_passes_per_step():
     out2.backward()
     assert opt._handles  # second pass triggered the allreduce
     opt.step()
-    # grad = (1+3)/2 per input element = 2 -> w = 1 - 2 = -1
-    assert torch.allclose(model.weight.data, torch.full((1, 2), -1.0))
+    # reference semantics (optimizer.py:219-247): the accumulated *sum* is
+    # allreduced unscaled -> grad = 1+3 = 4 -> w = 1 - 4 = -3
+    assert torch.allclose(model.weight.data, torch.full((1, 2), -3.0))
+    # and the wrapper is a real torch Optimizer (LR schedulers etc. accept it)
+    assert isinstance(opt, torch.optim.Optimizer)
+    torch.optim.lr_scheduler.StepLR(opt, step_size=10)
 
 
 def test_skip_synchronize():
