@@ -1,18 +1,25 @@
-"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+"""Headline benchmark: ResNet-50 synthetic training throughput + the
+BASELINE.md tracked configs.
 
 Mirrors the reference harness
 (/root/reference/examples/tensorflow2/tensorflow2_synthetic_benchmark.py):
 synthetic ImageNet-shaped data, full training step (forward + backward +
-gradient allreduce + update), report images/sec.
+gradient allreduce + update), report images/sec — plus:
 
-Baseline for vs_baseline: the reference's published ResNet-101 synthetic
-number — 1656.82 img/s over 16 Pascal GPUs = 103.55 img/s per device
-(/root/reference/docs/benchmarks.rst:31-41; BASELINE.md). We run ResNet-50
-(the BASELINE.json target metric) per chip on whatever devices exist.
+- ``mfu``: model FLOPs utilization against the detected chip's bf16 peak
+  (ResNet-50 fwd ≈ 4.09 GFLOP/img at 224², training ≈ 3× fwd).
+- ``allreduce_gbps``: eager fused allreduce bandwidth (BASELINE's stated
+  collective metric; config 3 adds bf16-compressed wire format).
+- ``adasum_step_ms``: Adasum reduction step (config 4).
+- ``moe_alltoall_ms``: expert-parallel all_to_all exchange (config 5).
+
+Timing uses an end-of-run *value fetch* as the sync point: on the
+tunneled TPU ``block_until_ready`` can acknowledge before device work
+completes, so fetching a scalar is the only trustworthy barrier.
 
 Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec/chip", "vs_baseline": N}
+   "unit": "images/sec/chip", "vs_baseline": N, "mfu": F, "extras": {...}}
 """
 
 import json
@@ -30,17 +37,34 @@ from horovod_tpu.parallel import data_parallel_step
 
 BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
 
-PER_CHIP_BATCH = 64
-WARMUP = 3
-ITERS = 20
+RESNET50_FWD_FLOP_PER_IMG = 4.09e9
+TRAIN_FLOP_MULT = 3.0  # fwd + bwd ≈ 3x fwd
+
+# bf16 peak FLOP/s by device kind (first matching substring wins)
+PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6", 918e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
 
 
-def main():
-    hvd.init()
+def chip_peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return 197e12  # conservative default: v5e
+
+
+def _sync(x) -> float:
+    """True synchronization: fetch a scalar value."""
+    return float(jnp.asarray(x).reshape(-1)[0])
+
+
+def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30):
     n = hvd.size()
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
-    batch = PER_CHIP_BATCH * n
+    batch = per_chip_batch * n
     images = jnp.asarray(
         np.random.RandomState(0).randn(batch, 224, 224, 3), jnp.bfloat16)
     labels = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
@@ -69,23 +93,135 @@ def main():
 
     compiled = data_parallel_step(step, batch_argnums=(2, 3))
     state = (params, batch_stats)
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         state, opt_state, loss = compiled(state, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    _sync(loss)
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         state, opt_state, loss = compiled(state, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    _sync(loss)
     dt = time.perf_counter() - t0
+    img_per_sec = batch * iters / dt
+    return img_per_sec / n
 
-    img_per_sec = batch * ITERS / dt
-    per_chip = img_per_sec / n
+
+def bench_eager_allreduce(nbytes: int = 64 << 20, iters: int = 10,
+                          compressed: bool = False):
+    """Eager fused allreduce GB/s (BASELINE metric; config 3 = compressed
+    wire). Single process: measures the host↔device staging + reduction
+    path; multi-process adds the cross-process collective."""
+    from horovod_tpu.ops.compression import Compression
+
+    x = np.random.RandomState(2).randn(nbytes // 4).astype(np.float32)
+    comp = Compression.bf16 if compressed else Compression.none
+    tag = "c" if compressed else "r"
+
+    def run_one(i):
+        t, ctx = comp.compress(jnp.asarray(x)) if compressed else (x, None)
+        h = hvd.allreduce_async(np.asarray(t), name=f"bench.ar.{tag}{i}",
+                                op=hvd.Sum)
+        out = hvd.synchronize(h)
+        return comp.decompress(out, ctx) if compressed else out
+
+    run_one(0)
+    t0 = time.perf_counter()
+    out = None
+    for i in range(1, iters + 1):
+        out = run_one(i)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    return nbytes / dt / 1e9
+
+
+def bench_adasum(nelem: int = 1 << 22, iters: int = 10):
+    """Adasum reduction step over the chip mesh (config 4)."""
+    from horovod_tpu.parallel import create_mesh
+    from jax.sharding import PartitionSpec as P
+
+    n = len(jax.devices())
+    mesh = create_mesh({"hvd": n})
+    x = jnp.asarray(np.random.RandomState(3).randn(n, nelem // n), jnp.float32)
+
+    def per_chip(xl):
+        return hvd.allreduce(xl[0], op=hvd.Adasum, axis_name="hvd")
+
+    f = jax.jit(jax.shard_map(per_chip, mesh=mesh, in_specs=P("hvd"),
+                              out_specs=P(), check_vma=False))
+    _sync(f(x))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(x)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def bench_moe_alltoall(tokens_per_chip: int = 2048, d_model: int = 512,
+                       iters: int = 20):
+    """Expert-parallel all_to_all dispatch+combine exchange (config 5)."""
+    from horovod_tpu.parallel import create_mesh
+    from jax.sharding import PartitionSpec as P
+    from jax import lax
+
+    n = len(jax.devices())
+    mesh = create_mesh({"ep": n})
+    x = jnp.asarray(np.random.RandomState(4).randn(
+        n * tokens_per_chip, d_model), jnp.bfloat16)
+
+    def per_chip(xl):
+        t = xl.reshape(n, tokens_per_chip // n, d_model)
+        y = lax.all_to_all(t, "ep", split_axis=0, concat_axis=0, tiled=False)
+        y = lax.all_to_all(y, "ep", split_axis=0, concat_axis=0, tiled=False)
+        return y.reshape(xl.shape)
+
+    f = jax.jit(jax.shard_map(per_chip, mesh=mesh, in_specs=P("ep"),
+                              out_specs=P("ep"), check_vma=False))
+    _sync(jnp.sum(f(x)))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(x)
+    _sync(jnp.sum(out))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    hvd.init()
+    quick = "--quick" in sys.argv  # CPU/CI smoke: tiny sizes
+    per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else 256)
+    per_chip_ips = bench_resnet(per_chip, warmup=2 if quick else 5,
+                                iters=3 if quick else 30)
+    flops = per_chip_ips * RESNET50_FWD_FLOP_PER_IMG * TRAIN_FLOP_MULT
+    mfu = flops / chip_peak_flops()
+    extras = {
+        "allreduce_gbps": round(bench_eager_allreduce(
+            (1 << 20) if quick else (64 << 20)), 2),
+        "allreduce_bf16_compressed_gbps": round(bench_eager_allreduce(
+            (1 << 20) if quick else (64 << 20), compressed=True), 2),
+        "adasum_step_ms": round(bench_adasum(
+            (1 << 16) if quick else (1 << 22)), 2),
+        "moe_alltoall_ms": round(bench_moe_alltoall(
+            256 if quick else 2048, 128 if quick else 512), 2),
+        "per_chip_batch": per_chip,
+        "device": jax.devices()[0].device_kind,
+    }
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": round(per_chip_ips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+        "vs_baseline": round(per_chip_ips / BASELINE_PER_DEVICE, 3),
+        "mfu": round(mfu, 4),
+        "extras": extras,
     }))
+
+
+def _sync_int_env(name, default):
+    import os
+
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 if __name__ == "__main__":

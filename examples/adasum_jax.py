@@ -1,0 +1,68 @@
+"""Adasum training example — BASELINE.md tracked config 4 (reference
+examples/adasum + docs/adasum_user_guide.rst usage shape): gradients are
+combined with the scale-invariant Adasum reduction over the ICI mesh
+instead of an average.
+
+Run single-chip:   python examples/adasum_jax.py
+Run multi-process: hvdrun -np 2 python examples/adasum_jax.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+from horovod_tpu.parallel import data_parallel_step, shard_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(args.batch * max(n, 1), 16).astype(np.float32)
+    W = rng.randn(16, 1).astype(np.float32)
+    y = (X @ W + 0.1 * rng.randn(len(X), 1)).astype(np.float32)
+
+    model = MLP(features=[64, 64, 1])
+    params = model.init(jax.random.PRNGKey(0), X[:1])
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = optax.sgd(0.01)
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            pred = model.apply(p, xb)
+            return jnp.mean((pred - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # the Horovod Adasum reduction (reference ReduceOp.ADASUM /
+        # adasum.h recursion) — here the ppermute hypercube over ICI
+        grads = jax.tree.map(
+            lambda g: hvd.allreduce(g, op=hvd.Adasum, axis_name="hvd"),
+            grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    compiled = data_parallel_step(step, batch_argnums=(2, 3))
+    xb, yb = shard_batch((X, y))
+    for i in range(args.steps):
+        params, opt_state, loss = compiled(params, opt_state, xb, yb)
+        if i % 10 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss={float(loss):.5f}")
+    if hvd.rank() == 0:
+        print(f"final loss={float(loss):.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Elastic training example (reference examples/elastic/ usage shape:
+``@hvd.elastic.run`` + a State object; workers can join/leave and training
+resumes from the last committed state).
+
+Run under the elastic launcher:
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh python examples/elastic_jax.py
+or single-process (degenerates to a plain loop):
+    python examples/elastic_jax.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.models import MLP
+from horovod_tpu.parallel import data_parallel_step, shard_batch
+
+
+def make_data(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 16).astype(np.float32)
+    W = rng.randn(16, 1).astype(np.float32)
+    y = (X @ W).astype(np.float32)
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+
+    hvd.init()
+    X, y = make_data()
+    model = MLP(features=[64, 1])
+    params = model.init(jax.random.PRNGKey(0), X[:1])
+    opt = optax.adam(1e-2 * hvd.size())  # LR scales with current world size
+    opt_state = opt.init(params)
+
+    state = elastic.JaxState(params=params, opt_state=opt_state, epoch=0)
+
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            return jnp.mean((model.apply(p, xb) - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(
+            lambda g: hvd.allreduce(g, axis_name="hvd"), grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    @elastic.run
+    def train(state):
+        compiled = data_parallel_step(step, batch_argnums=(2, 3))
+        n = hvd.size()
+        per = (len(X) // max(n, 1)) // args.batch * args.batch
+        while state.epoch < args.epochs:
+            # rank-strided shard of the data for the *current* world size
+            Xl = X[hvd.rank()::n][:per]
+            yl = y[hvd.rank()::n][:per]
+            loss = None
+            for i in range(0, per, args.batch):
+                xb, yb = shard_batch((Xl[i:i + args.batch],
+                                      yl[i:i + args.batch]))
+                state.params, state.opt_state, loss = compiled(
+                    state.params, state.opt_state, xb, yb)
+            state.epoch += 1
+            state.commit()  # snapshot + membership check
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.5f} "
+                      f"(world size {n})")
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic training complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""TF2 MNIST with DistributedGradientTape — BASELINE.md tracked config 2
+(reference examples/tensorflow2/tensorflow2_mnist.py usage shape:
+init → shard data by rank → tape-wrapped gradients → broadcast variables
+on first step → rank-0-only checkpoints).
+
+Run:  hvdrun -np 2 python examples/tensorflow2_mnist.py --steps 50
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Deterministic MNIST-shaped data (no dataset download in CI)."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int64)
+    # make it learnable: brighten a quadrant per class
+    for i in range(n):
+        q = y[i] % 4
+        r, c = divmod(q, 2)
+        x[i, r * 14:(r + 1) * 14, c * 14:(c + 1) * 14] += y[i] / 10.0
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.001)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    x, y = synthetic_mnist()
+    # shard by rank (Horovod-style per-worker dataset sharding)
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+    dataset = (tf.data.Dataset.from_tensor_slices((x, y))
+               .repeat().shuffle(1024, seed=hvd.rank())
+               .batch(args.batch))
+
+    import keras
+    keras.utils.set_random_seed(42 + hvd.rank())  # deliberately different
+    model = keras.Sequential([
+        keras.layers.Conv2D(16, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    loss_fn = keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    # scale LR by world size (Horovod's linear-scaling convention)
+    opt = keras.optimizers.Adam(args.lr * hvd.size())
+
+    for step, (images, labels) in enumerate(dataset.take(args.steps)):
+        with tf.GradientTape() as tape:
+            logits = model(images, training=True)
+            loss = loss_fn(labels, logits)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if step == 0:
+            # after the first step (variables now exist): align all workers
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+
+    # global accuracy via metric allreduce
+    logits = model(x[:512], training=False)
+    acc = float(np.mean(np.argmax(logits.numpy(), -1) == y[:512]))
+    acc = float(hvd.allreduce(tf.constant(acc), average=True,
+                              name="final.acc").numpy())
+    if hvd.rank() == 0:
+        print(f"final accuracy (global avg): {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

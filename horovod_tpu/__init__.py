@@ -156,7 +156,10 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
                             process_set: Optional[ProcessSet] = None) -> list[int]:
     """Enqueue a group in one shot; the cycle loop fuses them into a single
     flat collective (reference grouped allreduce + GroupTable)."""
-    base = name or "grouped"
+    # unnamed groups get a unique per-call base (reference
+    # "grouped_allreduce.noname.<n>"): two concurrently pending unnamed
+    # groups must not collide on the in-flight name guard
+    base = name or _default_name("grouped_allreduce", tensors)
     return [allreduce_async(t, average, f"{base}.{i}", op=op, process_set=process_set)
             for i, t in enumerate(tensors)]
 

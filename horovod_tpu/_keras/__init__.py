@@ -1,0 +1,116 @@
+"""Shared Keras implementation layer (reference horovod/_keras/__init__.py).
+
+``create_distributed_optimizer`` dynamically subclasses the wrapped Keras
+optimizer's own class (reference _keras/__init__.py:28-166) so
+isinstance-based integrations keep working, and intercepts
+``apply_gradients``/``apply`` to allreduce gradients across workers first.
+Works with Keras 3 (the installed generation) under any backend whose
+gradients materialize as host-convertible arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import horovod_tpu as _core
+
+
+def _allreduce_np(values, op, prescale, postscale, prefix):
+    handles = [
+        _core.allreduce_async(np.asarray(v), None, f"{prefix}.{i}", op=op,
+                              prescale_factor=prescale,
+                              postscale_factor=postscale)
+        for i, v in enumerate(values)
+    ]
+    return [np.asarray(_core.synchronize(h)) for h in handles]
+
+
+def create_distributed_optimizer(optimizer, name: Optional[str] = None,
+                                 compression=None, op=None,
+                                 gradient_predivide_factor: float = 1.0,
+                                 process_set=None):
+    import keras
+
+    op = _core.Average if op is None else op
+    if gradient_predivide_factor != 1.0:
+        if op != _core.Average:
+            raise ValueError("gradient_predivide_factor requires op=Average")
+        wire_op = _core.Sum
+        pre = 1.0 / gradient_predivide_factor
+        # post divide by size happens via postscale
+        post_of = lambda n: gradient_predivide_factor / n  # noqa: E731
+    else:
+        wire_op, pre, post_of = op, 1.0, lambda n: 1.0
+
+    cls = optimizer.__class__
+    if getattr(cls, "_hvd_wrapped", False):
+        raise ValueError("optimizer is already a DistributedOptimizer")
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+        _hvd_base = cls
+
+        def _hvd_reduce(self, grads):
+            n = (process_set or _core.global_process_set()).cross_size
+            if n <= 1 and _core.size() <= 1:
+                return grads
+            post = post_of(max(n, 1))
+            if keras.backend.backend() == "tensorflow":
+                # model.fit traces train_step with tf.function: gradients
+                # are symbolic there, so the eager-runtime allreduce rides
+                # a py_function that executes at step time (the role of
+                # the reference's HorovodAllreduce custom op).
+                import tensorflow as tf
+
+                grads = list(grads)
+
+                def _reduce(*gs):
+                    arrs = [g.numpy() for g in gs]
+                    red = _allreduce_np(arrs, wire_op, pre, post,
+                                        "keras.grad")
+                    return [r.astype(a.dtype) for r, a in zip(red, arrs)]
+
+                reduced = tf.py_function(
+                    _reduce, grads, [g.dtype for g in grads])
+                if not isinstance(reduced, (list, tuple)):
+                    reduced = [reduced]
+                for r, g in zip(reduced, grads):
+                    r.set_shape(g.shape)
+                return list(reduced)
+            arrs = [np.asarray(g) for g in grads]
+            reduced = _allreduce_np(arrs, wire_op, pre, post, "keras.grad")
+            return [keras.ops.convert_to_tensor(r.astype(a.dtype))
+                    for r, a in zip(reduced, arrs)]
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            gv = list(grads_and_vars)
+            grads = self._hvd_reduce([g for g, _ in gv])
+            return super().apply_gradients(
+                [(g, v) for g, (_, v) in zip(grads, gv)], **kwargs)
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            grads = self._hvd_reduce(list(grads))
+            if trainable_variables is None:
+                return super().apply(grads, **kwargs)
+            return super().apply(grads, trainable_variables, **kwargs)
+
+    _Distributed.__name__ = name or f"Distributed{cls.__name__}"
+    config = optimizer.get_config()
+    new = _Distributed(**config)
+    # carry over any already-built state (slot variables etc.)
+    if getattr(optimizer, "built", False):
+        try:
+            new.build(optimizer._trainable_variables)
+            for a, b in zip(new.variables, optimizer.variables):
+                a.assign(b)
+        except Exception:
+            pass
+    return new
+
+
+def broadcast_global_variables(backend, root_rank: int = 0):
+    raise NotImplementedError(
+        "TF1 session-style broadcast is not supported; use "
+        "hvd.broadcast_variables(model.variables, root_rank)")

@@ -1,0 +1,116 @@
+"""Keras callbacks (reference horovod/_keras/callbacks.py +
+keras/callbacks.py): broadcast-on-start, metric averaging, LR warmup and
+schedules, elastic state commits — attached to a real ``model.fit`` loop.
+"""
+
+from __future__ import annotations
+
+import keras
+import numpy as np
+
+import horovod_tpu as _core
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model/optimizer variables from ``root_rank`` at the
+    start of training (reference BroadcastGlobalVariablesCallbackImpl):
+    every worker starts from identical state after random init or a
+    rank-0-only checkpoint restore."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done:
+            return
+        self._done = True
+        if _core.cross_size() <= 1:
+            return
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            variables += list(getattr(opt, "variables", []) or [])
+        for i, v in enumerate(variables):
+            out = _core.synchronize(_core.broadcast_async(
+                np.asarray(v), self.root_rank, f"keras.bcast.{i}"))
+            v.assign(np.asarray(out).astype(np.asarray(v).dtype))
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over all workers before they reach other
+    callbacks (reference MetricAverageCallbackImpl) — so checkpointing /
+    early stopping see global, not rank-local, values."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or _core.cross_size() <= 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating)))
+        if not keys:
+            return
+        vals = np.asarray([float(logs[k]) for k in keys], np.float32)
+        avg = np.asarray(_core.synchronize(_core.allreduce_async(
+            vals, average=True, name=f"keras.metrics.e{epoch}")))
+        for k, v in zip(keys, avg):
+            logs[k] = float(v)
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Linear LR ramp from ``initial_lr / size`` (or given start) to
+    ``initial_lr`` over the first ``warmup_epochs`` (reference
+    LearningRateWarmupCallbackImpl — the Goyal et al. large-batch recipe).
+    """
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._current_epoch = 0
+
+    def _set_lr(self, lr: float):
+        self.model.optimizer.learning_rate.assign(lr)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self._current_epoch >= self.warmup_epochs:
+            return
+        spe = self.steps_per_epoch or self.params.get("steps") or 1
+        progress = (self._current_epoch * spe + batch + 1) / float(
+            self.warmup_epochs * spe)
+        base = self.initial_lr / max(_core.size(), 1)
+        self._set_lr(base + (self.initial_lr - base) * min(progress, 1.0))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose:
+            print(f"warmup complete: lr={self.initial_lr}")
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the LR by ``multiplier`` inside [start_epoch, end_epoch)
+    (reference LearningRateScheduleCallbackImpl)."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch=None, staircase: bool = True):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda e: multiplier))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if epoch < self.start_epoch or (
+                self.end_epoch is not None and epoch >= self.end_epoch):
+            return
+        e = epoch if self.staircase else epoch  # per-epoch granularity
+        self.model.optimizer.learning_rate.assign(
+            self.initial_lr * self.multiplier(e))

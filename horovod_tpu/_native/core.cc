@@ -1,0 +1,168 @@
+// horovod_tpu native runtime core.
+//
+// TPU-native equivalents of the reference's native hot paths (SURVEY.md
+// N9 fusion buffer memcpy in/out — collective_operations.h:65-88 /
+// fusion_buffer_manager.h; N11 timeline SPSC queue — timeline.h:84-100):
+//
+//  * hvd_pack / hvd_unpack: batched memcpy of N tensors into/out of one
+//    persistent fusion buffer, multi-threaded above a size threshold
+//    (the role of the reference's MemcpyInFusionBuffer + batched D2D
+//    kernel, done host-side here because the device side is one fused
+//    XLA program).
+//  * an SPSC ring for timeline events so the hot enqueue path never
+//    blocks on the writer thread (reference boost::lockfree::spsc_queue).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -o libhvdcore.so core.cc -lpthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Fusion buffer pack/unpack
+// ---------------------------------------------------------------------------
+
+// Parallel memcpy threshold: below this total size the thread spawn costs
+// more than the copy.
+static const int64_t kParallelBytes = 1 << 22;  // 4 MiB
+
+static void copy_ranges(const void** srcs, void** dsts,
+                        const int64_t* sizes, int begin, int end) {
+  for (int i = begin; i < end; ++i) {
+    std::memcpy(dsts[i], srcs[i], static_cast<size_t>(sizes[i]));
+  }
+}
+
+// Pack n tensors (srcs[i], sizes[i] bytes) contiguously into dst.
+// Returns total bytes packed.
+int64_t hvd_pack(const void** srcs, const int64_t* sizes, int n, void* dst) {
+  std::vector<void*> dsts(n);
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    dsts[i] = static_cast<char*>(dst) + off;
+    off += sizes[i];
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  if (off < kParallelBytes || n < 2 || hw < 2) {
+    copy_ranges(srcs, dsts.data(), sizes, 0, n);
+    return off;
+  }
+  int nthreads = static_cast<int>(hw < 8 ? hw : 8);
+  if (nthreads > n) nthreads = n;
+  std::vector<std::thread> workers;
+  int per = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int b = t * per, e = b + per > n ? n : b + per;
+    if (b >= e) break;
+    workers.emplace_back(copy_ranges, srcs, dsts.data(), sizes, b, e);
+  }
+  for (auto& w : workers) w.join();
+  return off;
+}
+
+// Unpack a contiguous src into n tensors (dsts[i], sizes[i] bytes).
+int64_t hvd_unpack(const void* src, void** dsts, const int64_t* sizes,
+                   int n) {
+  std::vector<const void*> srcs(n);
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    srcs[i] = static_cast<const char*>(src) + off;
+    off += sizes[i];
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  if (off < kParallelBytes || n < 2 || hw < 2) {
+    copy_ranges(srcs.data(), dsts, sizes, 0, n);
+    return off;
+  }
+  int nthreads = static_cast<int>(hw < 8 ? hw : 8);
+  if (nthreads > n) nthreads = n;
+  std::vector<std::thread> workers;
+  int per = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int b = t * per, e = b + per > n ? n : b + per;
+    if (b >= e) break;
+    workers.emplace_back(copy_ranges, srcs.data(), dsts, sizes, b, e);
+  }
+  for (auto& w : workers) w.join();
+  return off;
+}
+
+// ---------------------------------------------------------------------------
+// Timeline SPSC ring (single producer: enqueue path; single consumer:
+// writer thread)
+// ---------------------------------------------------------------------------
+
+// Multi-producer (user threads + cycle thread both emit events), single
+// consumer (writer thread). Producers serialize on a mutex — event rates
+// are low and payloads tiny, so contention is negligible; the consumer
+// drains lock-free against the atomic head.
+struct TlRing {
+  std::vector<std::string> slots;
+  std::atomic<uint64_t> head{0};  // next write (producers)
+  std::atomic<uint64_t> tail{0};  // next read (consumer)
+  uint64_t capacity;
+  std::atomic<uint64_t> dropped{0};
+  std::mutex produce_mu;
+};
+
+void* hvd_tl_create(int64_t capacity) {
+  TlRing* r = new TlRing();
+  r->capacity = static_cast<uint64_t>(capacity);
+  r->slots.resize(r->capacity);
+  return r;
+}
+
+void hvd_tl_destroy(void* ring) { delete static_cast<TlRing*>(ring); }
+
+// Returns 1 on success, 0 when full (event dropped — matches the
+// reference's lossy-under-pressure queue semantics).
+int hvd_tl_push(void* ring, const char* data, int64_t len) {
+  TlRing* r = static_cast<TlRing*>(ring);
+  std::lock_guard<std::mutex> lock(r->produce_mu);
+  uint64_t head = r->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->tail.load(std::memory_order_acquire);
+  if (head - tail >= r->capacity) {
+    r->dropped.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  r->slots[head % r->capacity].assign(data, static_cast<size_t>(len));
+  r->head.store(head + 1, std::memory_order_release);
+  return 1;
+}
+
+// Drain up to buflen bytes of newline-separated events into buf.
+// Returns bytes written (0 = empty).
+int64_t hvd_tl_drain(void* ring, char* buf, int64_t buflen) {
+  TlRing* r = static_cast<TlRing*>(ring);
+  int64_t written = 0;
+  uint64_t tail = r->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->head.load(std::memory_order_acquire);
+  while (tail < head) {
+    const std::string& s = r->slots[tail % r->capacity];
+    int64_t need = static_cast<int64_t>(s.size()) + 1;
+    if (written + need > buflen) break;
+    std::memcpy(buf + written, s.data(), s.size());
+    written += static_cast<int64_t>(s.size());
+    buf[written++] = '\n';
+    ++tail;
+  }
+  r->tail.store(tail, std::memory_order_release);
+  return written;
+}
+
+int64_t hvd_tl_dropped(void* ring) {
+  return static_cast<int64_t>(
+      static_cast<TlRing*>(ring)->dropped.load(std::memory_order_relaxed));
+}
+
+int hvd_abi_version() { return 1; }
+
+}  // extern "C"

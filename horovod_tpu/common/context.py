@@ -245,8 +245,13 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
             if _ctx.config.autotune:
                 from ..utils.autotune import Autotuner
 
-                _ctx.autotuner = Autotuner(_ctx.runtime, log_path=_ctx.config.autotune_log)
+                _ctx.autotuner = Autotuner(
+                    _ctx.runtime, log_path=_ctx.config.autotune_log,
+                    warmup_samples=_ctx.config.autotune_warmup_samples,
+                    max_samples=_ctx.config.autotune_max_samples)
                 _ctx.runtime.autotuner = _ctx.autotuner
+                _ctx.runtime.autotune_steps_per_sample = (
+                    _ctx.config.autotune_steps_per_sample)
         _ctx.initialized = True
         LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
 

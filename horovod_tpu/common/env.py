@@ -22,6 +22,9 @@ HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
@@ -101,6 +104,9 @@ class RuntimeConfig:
     timeline_mark_cycles: bool = False
     autotune: bool = False
     autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 20
+    autotune_max_samples: int = 20
     stall_check_disable: bool = False
     stall_warning_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0
@@ -121,6 +127,13 @@ class RuntimeConfig:
         c.timeline_mark_cycles = get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
         c.autotune = get_bool(HOROVOD_AUTOTUNE)
         c.autotune_log = get_str(HOROVOD_AUTOTUNE_LOG)
+        # same knob names as reference utils/env_parser.cc autotune block
+        c.autotune_warmup_samples = get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES,
+                                            c.autotune_warmup_samples)
+        c.autotune_steps_per_sample = get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE,
+                                              c.autotune_steps_per_sample)
+        c.autotune_max_samples = get_int(HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+                                         c.autotune_max_samples)
         c.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
         c.stall_warning_time_s = get_float(HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0)
         c.stall_shutdown_time_s = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)

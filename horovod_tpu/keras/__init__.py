@@ -1,0 +1,68 @@
+"""horovod_tpu.keras — the Keras-facing API (reference horovod/keras +
+horovod/tensorflow/keras).
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt, ...)
+    model.fit(..., callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                              hvd.callbacks.MetricAverageCallback()])
+"""
+
+from __future__ import annotations
+
+import keras
+
+import horovod_tpu as _core
+from horovod_tpu import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+    allgather_object,
+    broadcast_object,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu._keras import create_distributed_optimizer
+from horovod_tpu._keras import callbacks  # noqa: F401
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None):
+    """Dynamic-subclass optimizer wrap (reference keras/__init__.py:40 →
+    _keras/__init__.py:28-166)."""
+    return create_distributed_optimizer(
+        optimizer, name=name, compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set)
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    import numpy as np
+
+    for i, v in enumerate(variables):
+        out = _core.synchronize(_core.broadcast_async(
+            np.asarray(v), root_rank, f"keras.bcastvar.{i}"))
+        v.assign(np.asarray(out).astype(np.asarray(v).dtype))
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a Keras model and re-wrap its optimizer as a
+    DistributedOptimizer (reference keras/__init__.py load_model →
+    _keras wrap_optimizer)."""
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects or {})
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(opt.__class__, "_hvd_wrapped", False):
+        model.optimizer = DistributedOptimizer(opt, compression=compression)
+    return model

@@ -1,0 +1,167 @@
+"""horovod_tpu.mxnet — the MXNet-facing API (reference horovod/mxnet/:
+mpi_ops.py + __init__.py — DistributedOptimizer :40, gluon
+DistributedTrainer :102, broadcast_parameters :191).
+
+MXNet is not installed in this image; the module gates on import and
+raises a clear error from every entry point, while keeping the full API
+surface importable for introspection (``horovod_tpu.mxnet.MXNET_AVAILABLE``
+tells integrations at runtime). When an mxnet wheel is present the
+implementations below activate: NDArrays cross the boundary as numpy and
+collectives execute on the shared horovod_tpu eager runtime, exactly like
+the torch/tf shims.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import horovod_tpu as _core
+from horovod_tpu import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+try:
+    import mxnet as mx  # noqa: F401
+
+    MXNET_AVAILABLE = True
+except ImportError:
+    mx = None
+    MXNET_AVAILABLE = False
+
+
+def _require_mxnet():
+    if not MXNET_AVAILABLE:
+        raise ImportError(
+            "horovod_tpu.mxnet requires the `mxnet` package, which is not "
+            "installed in this environment")
+
+
+def _to_np(t) -> np.ndarray:
+    return t.asnumpy() if hasattr(t, "asnumpy") else np.asarray(t)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              priority: int = 0, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    _require_mxnet()
+    out = _core.synchronize(_core.allreduce_async(
+        _to_np(tensor), average, name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+    return mx.nd.array(np.asarray(out), ctx=tensor.context,
+                       dtype=tensor.dtype)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               priority: int = 0):
+    _require_mxnet()
+    out = allreduce(tensor, average, name, priority)
+    tensor[:] = out
+    return tensor
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0):
+    _require_mxnet()
+    out = _core.synchronize(_core.allgather_async(_to_np(tensor), name))
+    return mx.nd.array(np.asarray(out), ctx=tensor.context,
+                       dtype=tensor.dtype)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              priority: int = 0):
+    _require_mxnet()
+    out = _core.synchronize(_core.broadcast_async(_to_np(tensor), root_rank,
+                                                  name))
+    return mx.nd.array(np.asarray(out), ctx=tensor.context,
+                       dtype=tensor.dtype)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               priority: int = 0):
+    _require_mxnet()
+    out = broadcast(tensor, root_rank, name, priority)
+    tensor[:] = out
+    return tensor
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0):
+    _require_mxnet()
+    out, recv = _core.synchronize(_core.alltoall_async(
+        _to_np(tensor), None if splits is None else _to_np(splits), name))
+    return (mx.nd.array(np.asarray(out), ctx=tensor.context),
+            mx.nd.array(np.asarray(recv)))
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Gluon ParameterDict or plain dict of NDArrays (reference
+    mxnet/__init__.py:191)."""
+    _require_mxnet()
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params type")
+    for name, p in items:
+        arr = p.data() if hasattr(p, "data") else p
+        out = _core.synchronize(_core.broadcast_async(
+            _to_np(arr), root_rank, f"mx.bcast.{name}"))
+        arr[:] = np.asarray(out)
+
+
+class DistributedOptimizer:
+    """Wraps an mx.optimizer.Optimizer: gradients are allreduced in
+    update()/update_multi_precision() before the wrapped update runs
+    (reference mxnet/__init__.py:40)."""
+
+    def __init__(self, optimizer):
+        _require_mxnet()
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _reduce(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            for i, g in zip(index, grad):
+                g[:] = allreduce(g, average=True, name=f"mx.grad.{i}")
+        else:
+            grad[:] = allreduce(grad, average=True, name=f"mx.grad.{index}")
+
+    def update(self, index, weight, grad, state):
+        self._reduce(index, grad)
+        return self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._reduce(index, grad)
+        return self._optimizer.update_multi_precision(index, weight, grad,
+                                                      state)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None, **kwargs):
+    """Gluon trainer wrapper (reference mxnet/__init__.py:102): allreduces
+    gradients at step time."""
+    _require_mxnet()
+    import mxnet.gluon as gluon
+
+    class _Trainer(gluon.Trainer):
+        def step(self, batch_size, ignore_stale_grad=False):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        g[:] = allreduce(g, average=True,
+                                         name=f"mx.trainer.{i}")
+            super().step(batch_size, ignore_stale_grad)
+
+    return _Trainer(params, optimizer, optimizer_params, **kwargs)

@@ -118,9 +118,21 @@ def _traced_allreduce(x, op, axis_name, prescale_factor, postscale_factor):
 # The cache below is the TPU-shaped analogue of the response cache
 # (reference response_cache.h:45): steady-state eager training re-issues the
 # same (op, shape, dtype) signatures, and we skip straight to a compiled
-# program instead of re-negotiating.
+# program instead of re-negotiating. Like the reference cache it is
+# LRU-bounded by ``HOROVOD_CACHE_CAPACITY`` (reference operations.cc:467,
+# response_cache.cc set_capacity): a workload cycling through more distinct
+# signatures than the capacity evicts the least recently used program.
 
-_EAGER_CACHE: dict = {}
+from collections import OrderedDict
+
+_EAGER_CACHE: "OrderedDict" = OrderedDict()
+
+
+def _cache_capacity() -> int:
+    try:
+        return ctx_mod.context().config.cache_capacity
+    except Exception:
+        return 1024
 
 
 def _cached(key, builder):
@@ -128,6 +140,11 @@ def _cached(key, builder):
     if fn is None:
         fn = builder()
         _EAGER_CACHE[key] = fn
+        cap = _cache_capacity()
+        while cap > 0 and len(_EAGER_CACHE) > cap:
+            _EAGER_CACHE.popitem(last=False)
+    else:
+        _EAGER_CACHE.move_to_end(key)
     return fn
 
 
@@ -159,6 +176,15 @@ def _to_local_np(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _hierarchical_enabled(kind: str) -> bool:
+    try:
+        cfg = ctx_mod.context().config
+    except Exception:
+        return False
+    return (cfg.hierarchical_allreduce if kind == "allreduce"
+            else cfg.hierarchical_allgather)
+
+
 def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
     xl = _to_local_np(x)
     nproc = ps.cross_size
@@ -170,11 +196,15 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
             pass  # adasum over a single contributor is identity
         return jnp.asarray(out)
 
+    hier = (_hierarchical_enabled("allreduce")
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+            and ps.mesh_2d is not None
+            and ps.mesh_2d.shape[LOCAL_AXIS] > 1)
     key = ("allreduce", ps.name, xl.shape, str(xl.dtype), int(op),
-           float(prescale_factor), float(postscale_factor))
+           float(prescale_factor), float(postscale_factor), hier)
 
     def build():
-        def f(g):
+        def reduce_flat(g):
             g = g * prescale_factor if prescale_factor != 1.0 else g
             if op == ReduceOp.AVERAGE:
                 r = jnp.mean(g, axis=0)
@@ -193,6 +223,42 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
             else:
                 raise ValueError(f"unsupported op {op}")
             return r * postscale_factor if postscale_factor != 1.0 else r
+
+        if not hier:
+            return jax.jit(reduce_flat, out_shardings=_replicated(ps))
+
+        # Two-level path (HOROVOD_HIERARCHICAL_ALLREDUCE; reference
+        # NCCLHierarchicalAllreduce, nccl_operations.cc:188-370:
+        # ReduceScatter-intra → Allreduce-cross → Allgather-intra). Each
+        # local chip takes 1/nlocal of the row, psums it over the process
+        # axis (cross traffic / nlocal per chip), then the reduced shards
+        # are allgathered back over the intra-process (ICI) axis.
+        mesh = ps.mesh_2d
+        nl = mesh.shape[LOCAL_AXIS]
+
+        def per_chip(gl):  # gl: [1, ...] — this process's row
+            x0 = gl[0]
+            flat = x0.reshape(-1)
+            pad = (-flat.size) % nl
+            padded = jnp.pad(flat, (0, pad))
+            csz = padded.size // nl
+            li = lax.axis_index(LOCAL_AXIS)
+            chunk = lax.dynamic_slice(padded, (li * csz,), (csz,))
+            if prescale_factor != 1.0:
+                chunk = chunk * prescale_factor
+            red = lax.psum(chunk, PROC_AXIS)
+            if op == ReduceOp.AVERAGE:
+                red = red / ps.cross_size
+            if postscale_factor != 1.0:
+                red = red * postscale_factor
+            full = _traced_allgather(red[None], LOCAL_AXIS)
+            full = full.reshape(-1)[:flat.size]
+            return full.reshape(x0.shape)
+
+        def f(g):
+            return jax.shard_map(per_chip, mesh=mesh,
+                                 in_specs=P(PROC_AXIS),
+                                 out_specs=P(), check_vma=False)(g)
 
         return jax.jit(f, out_shardings=_replicated(ps))
 
@@ -221,11 +287,46 @@ def _eager_allgather(x, ps: ProcessSet):
 
 
 def _eager_allgather_fixed(xl: np.ndarray, ps: ProcessSet):
-    key = ("allgather", ps.name, xl.shape, str(xl.dtype))
+    hier = (_hierarchical_enabled("allgather")
+            and ps.mesh_2d is not None
+            and ps.mesh_2d.shape[LOCAL_AXIS] > 1
+            and xl.size > 0)
+    key = ("allgather", ps.name, xl.shape, str(xl.dtype), hier)
 
     def build():
-        def f(g):  # g: [nproc, n, ...] -> [nproc*n, ...]
-            return g.reshape((-1,) + g.shape[2:])
+        if not hier:
+            def f(g):  # g: [nproc, n, ...] -> [nproc*n, ...]
+                return g.reshape((-1,) + g.shape[2:])
+
+            return jax.jit(f, out_shardings=_replicated(ps))
+
+        # Two-level allgather (HOROVOD_HIERARCHICAL_ALLGATHER; reference
+        # MPIHierarchicalAllgather's staged gather, mpi_operations.cc:190):
+        # each local chip gathers 1/nlocal of every remote row over the
+        # cross-process axis, then the shards are exchanged over ICI.
+        mesh = ps.mesh_2d
+        nl = mesh.shape[LOCAL_AXIS]
+        nproc = ps.cross_size
+
+        def per_chip(gl):  # gl: [1, n, ...] — this process's row
+            x0 = gl[0]
+            flat = x0.reshape(-1)
+            pad = (-flat.size) % nl
+            padded = jnp.pad(flat, (0, pad))
+            csz = padded.size // nl
+            li = lax.axis_index(LOCAL_AXIS)
+            chunk = lax.dynamic_slice(padded, (li * csz,), (csz,))
+            rows = _traced_allgather(chunk[None], PROC_AXIS)  # [nproc, csz]
+            full = _traced_allgather(rows[None], LOCAL_AXIS)  # [nl*nproc,csz]
+            full = full.reshape(nl, nproc, csz).transpose(1, 0, 2)
+            full = full.reshape(nproc, nl * csz)[:, :flat.size]
+            return full.reshape((nproc,) + x0.shape).reshape(
+                (-1,) + x0.shape[1:])
+
+        def f(g):
+            return jax.shard_map(per_chip, mesh=mesh,
+                                 in_specs=P(PROC_AXIS),
+                                 out_specs=P(), check_vma=False)(g)
 
         return jax.jit(f, out_shardings=_replicated(ps))
 
@@ -514,19 +615,24 @@ def reducescatter(
 
 
 def join() -> int:
-    """Barrier marking this process done with collective work for uneven
-    data (reference JoinOp, collective_operations.h:271; joined ranks
-    contribute zeros, global_state.h:107-111).
+    """Mark this process done with collective work for uneven data
+    (reference JoinOp, collective_operations.h:271; joined ranks contribute
+    zeros, global_state.h:107-111).
 
-    On the compiled path uneven batches are handled with masked psums (see
-    `horovod_tpu.opt`); eager join degenerates to a barrier. Returns the
-    last rank to join.
+    With the negotiation controller active, this rank keeps participating
+    in other ranks' collectives with fabricated zero contributions until
+    every rank has joined (true reference semantics). Without a controller
+    (single process / no rendezvous store) it degenerates to a barrier.
+    Returns the last rank to join.
     """
     ctx = ctx_mod.context()
     ctx.joined = True
     ps = ctx_mod.global_process_set()
     if ps.cross_size == 1:
         return ps.rank
+    rt = getattr(ctx, "runtime", None)
+    if rt is not None and rt.controller is not None:
+        return rt.join()
     last = _eager_allreduce(np.array([ps.rank], np.int32), ReduceOp.MAX, ps, 1.0, 1.0)
     return int(np.asarray(last)[0])
 

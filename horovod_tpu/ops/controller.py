@@ -24,12 +24,24 @@ TPU-shaped differences:
   algorithm), replacing FuseResponses' look-ahead (:777-849).
 
 Protocol (round r, scope ``ctl``):
-  worker k:  PUT  ctl/r{r}/ready/{k}   = JSON [ [name, sig], ... ]
+  worker k:  PUT  ctl/r{r}/ready/{k}   = JSON {"e": [[name, sig], ...],
+                                               "j": joined?}
+             (or the 1-byte SAME_AS_LAST marker when identical to round r-1)
   rank 0:    GET  ctl/r{r}/ready/* (all k) → count/validate/order
              PUT  ctl/r{r}/resp        = JSON {"ready": [names...],
-                                               "errors": {name: msg}}
+                                               "sigs": {name: sig},
+                                               "errors": {name: msg},
+                                               "join_done": last_rank|null}
   worker k:  GET  ctl/r{r}/resp (blocking) → execute / fail
 Rounds advance in lockstep; scope r-2 is garbage-collected by rank 0.
+
+Join semantics (reference JoinOp, collective_operations.h:271 +
+global_state.h:107-111 "joined ranks contribute zeros"): a joined rank keeps
+negotiating with ``j=true`` and counts as an implicit submitter for every
+tensor; the response's ``sigs`` let it fabricate a zero contribution of the
+right shape/dtype so the SPMD eager collective still runs everywhere. When
+every rank has joined, ``join_done`` carries the last rank to join and the
+joined state resets.
 """
 
 from __future__ import annotations
@@ -44,7 +56,8 @@ LOG = logging.getLogger("horovod_tpu")
 
 def entry_signature(entry) -> list:
     """Consistency-checked fields (reference ConstructResponse checks
-    dtype :538, op :548, shape :596, devices :619).
+    dtype :538, op :548, shape :596, devices :619; process-set identity is
+    part of the request key in post-v0.21 Horovod).
 
     Metadata only — reads .shape/.dtype attributes, never materializes the
     tensor (a device array must not be copied to host once per cycle just
@@ -56,9 +69,15 @@ def entry_signature(entry) -> list:
     t = entry.tensor
     shape = list(getattr(t, "shape", []))
     dtype = str(getattr(t, "dtype", type(t).__name__))
+    ps = getattr(entry, "process_set", None)
+    ps_name = getattr(ps, "name", None) or "global"
+    # Eager tensors are host-resident at enqueue; the consistency-relevant
+    # device identity is the platform the collective will execute on
+    # (reference controller.cc:619 validates CPU-vs-GPU placement).
+    dev = getattr(getattr(t, "sharding", None), "memory_kind", None) or "host"
     sig = [entry.op, dtype, shape, int(entry.reduce_op),
            entry.root_rank, float(entry.prescale_factor),
-           float(entry.postscale_factor)]
+           float(entry.postscale_factor), ps_name, str(dev)]
     entry._sig = sig
     return sig
 
@@ -72,22 +91,41 @@ class KVController:
     # slow rank stalls the round, never desyncs it.
     RESPONSE_TIMEOUT_S = 300.0
 
+    # Marker payload for the steady-state fast path: "my submitted set is
+    # identical to last round's". The moral of the reference response cache's
+    # bitvector sync (response_cache.h:45, controller.cc:139-237): repeated
+    # signature sets cost one cached-state bit per rank instead of a
+    # re-serialized, re-validated message list.
+    SAME_AS_LAST = b"="
+
     def __init__(self, client, rank: int, size: int,
-                 poll_timeout: float = RESPONSE_TIMEOUT_S):
+                 poll_timeout: float = RESPONSE_TIMEOUT_S,
+                 stall_warning_s: float = 60.0,
+                 stall_shutdown_s: float = 0.0):
         self.client = client
         self.rank = rank
         self.size = size
         self.round = 0
         self.poll_timeout = poll_timeout
         self.broken = False
+        self._last_payload: Optional[bytes] = None
+        # observability: wire bytes + fast-path round count (testable proxy
+        # for "negotiation cost is O(1) in steady state")
+        self.bytes_sent = 0
+        self.fast_rounds = 0
         self._coord: Optional[_Coordinator] = None
         if rank == 0:
-            self._coord = _Coordinator(client, size)
+            self._coord = _Coordinator(client, size,
+                                       stall_warning_s=stall_warning_s,
+                                       stall_shutdown_s=stall_shutdown_s)
             self._coord.start()
 
-    def negotiate(self, pending: dict[str, list]) -> tuple[list[str], dict[str, str]]:
-        """Submit this process's ready set; return (ordered ready names,
-        per-name errors). Blocks for the round's response.
+    def negotiate(self, pending: dict[str, list],
+                  joined: bool = False) -> dict:
+        """Submit this process's ready set; return the round response dict
+        (``ready`` ordered names, ``errors`` per-name, ``sigs`` for ready
+        names, ``join_done`` last-joined rank or None). Blocks for the
+        round's response.
 
         Any failure marks the controller broken: a worker that missed a
         round can never rejoin the lockstep safely (other ranks may have
@@ -99,15 +137,31 @@ class KVController:
             raise RuntimeError("controller is broken; re-initialize horovod_tpu")
         r = self.round
         try:
-            payload = json.dumps([[n, sig] for n, sig in pending.items()]).encode()
-            self.client.put(f"ctl/r{r}", f"ready/{self.rank}", payload)
+            payload = json.dumps(
+                {"e": [[n, sig] for n, sig in pending.items()],
+                 "j": bool(joined)}).encode()
+            if payload == self._last_payload:
+                wire = self.SAME_AS_LAST
+                self.fast_rounds += 1
+            else:
+                wire = payload
+            self.client.put(f"ctl/r{r}", f"ready/{self.rank}", wire)
+            self.bytes_sent += len(wire)
+            self._last_payload = payload
             resp = json.loads(self.client.get(f"ctl/r{r}", "resp",
                                               timeout=self.poll_timeout))
         except Exception:
             self.broken = True
             raise
         self.round += 1
-        return resp["ready"], resp.get("errors", {})
+        if resp.get("invalidate"):
+            # coordinator dropped its submission cache (error-closed
+            # round): the next round must carry a full payload
+            self._last_payload = None
+        resp.setdefault("errors", {})
+        resp.setdefault("sigs", {})
+        resp.setdefault("join_done", None)
+        return resp
 
     def stop(self):
         if self._coord:
@@ -115,58 +169,165 @@ class KVController:
 
 
 class _Coordinator(threading.Thread):
-    """Rank-0 aggregation loop (the MessageTable owner, controller.h:35)."""
+    """Rank-0 aggregation loop (the MessageTable owner, controller.h:35).
 
-    def __init__(self, client, size: int):
+    Stall attribution (reference stall_inspector.h:39 + the gathered
+    ready-lists of mpi_controller.cc:108): the coordinator knows, per
+    pending tensor, exactly which ranks have submitted it — so when a round
+    stalls it names the tensors *and the ranks the round is waiting on*,
+    and, past ``stall_shutdown_s``, error-closes the round so workers fail
+    fast into elastic recovery instead of hanging forever.
+    """
+
+    def __init__(self, client, size: int, stall_warning_s: float = 60.0,
+                 stall_shutdown_s: float = 0.0):
         super().__init__(daemon=True, name="hvd-coordinator")
         self.client = client
         self.size = size
+        self.stall_warning_s = stall_warning_s
+        self.stall_shutdown_s = stall_shutdown_s
         self._stop_evt = threading.Event()
         # name -> (sig, set of ranks that submitted) — persists across
         # rounds like the reference's message_table_
         self.table: dict[str, tuple[list, set[int]]] = {}
         self.order: list[str] = []  # rank-0-submission-order tie break
         self.errors: dict[str, str] = {}
+        # rank -> last full submission (for SAME_AS_LAST fast-path decode)
+        self._last_submission: dict[int, dict] = {}
+        # join tracking (reference JoinOp: joined_size / joined ranks,
+        # global_state.h:107-111)
+        self._joined: set[int] = set()
+        self._last_joined_rank: int = -1
+        # name -> first time it entered the table (stall attribution)
+        self._first_seen: dict[str, float] = {}
+        self._stall_warned: set[str] = set()
+        self.stall_warnings = 0  # observability for tests
 
-    # per-rank wait per attempt; transient misses retry until stop —
-    # a rank stuck in a long XLA compile must stall the round, not kill the
-    # coordinator (the reference tolerates stalls and only *warns*,
-    # stall_inspector.h:39)
-    STRAGGLER_TIMEOUT_S = 30.0
+    # Per-attempt poll while gathering a round. Short so a stalled round is
+    # noticed and attributed within ~stall_warning_s, not after a silent
+    # multi-minute block (the round-1 weakness: the coordinator waited
+    # forever without saying which rank was missing).
+    POLL_TIMEOUT_S = 1.0
 
-    def _get_with_retry(self, scope: str, key: str) -> Optional[bytes]:
-        while not self._stop_evt.is_set():
-            try:
-                return self.client.get(scope, key,
-                                       timeout=self.STRAGGLER_TIMEOUT_S)
-            except Exception:
-                continue  # straggler: keep waiting for this rank
-        return None
+    def _warn_stall(self, round_no: int, missing: set[int], elapsed: float):
+        waiting = {
+            n: sorted(set(range(self.size)) - ranks)
+            for n, (_, ranks) in self.table.items()
+            if len(ranks) < self.size
+        }
+        detail = "; ".join(
+            f"tensor {n!r} waiting on ranks {w}" for n, w in waiting.items()
+        ) or "no named tensors pending"
+        LOG.warning(
+            "Negotiation round %d stalled for %.0f s: ranks %s have not "
+            "reported. %s (reference CheckForStalledTensors, "
+            "stall_inspector.h:39)",
+            round_no, elapsed, sorted(missing), detail)
+        self.stall_warnings += 1
+
+    def _error_close_round(self, r: int, missing: set[int], elapsed: float):
+        """Past stall_shutdown_s: fail every pending tensor with a message
+        naming the absent ranks (reference stall-shutdown,
+        stall_inspector.cc + HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)."""
+        msg = (f"collective negotiation stalled for {elapsed:.0f} s waiting "
+               f"on ranks {sorted(missing)}; shutting the round down "
+               "(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS exceeded)")
+        errors = {n: msg for n in self.order}
+        self.table.clear()
+        self.order.clear()
+        self.errors.clear()
+        # the round's submissions were discarded (some never read), so the
+        # SAME_AS_LAST decode cache is stale on both sides: drop it here
+        # and tell workers to resend full payloads next round
+        self._last_submission.clear()
+        self.client.put(f"ctl/r{r}", "resp",
+                        json.dumps({"ready": [], "errors": errors,
+                                    "invalidate": True}).encode())
+
+    def _gather_round(self, r: int) -> Optional[dict[int, bytes]]:
+        """Collect every rank's round-r submission, attributing stalls.
+        Returns None when stopping or after an error-close."""
+        import time as _time
+
+        got: dict[int, bytes] = {}
+        missing = set(range(self.size))
+        start = _time.monotonic()
+        warned_at = 0.0
+        while missing and not self._stop_evt.is_set():
+            for k in sorted(missing):
+                try:
+                    got[k] = self.client.get(f"ctl/r{r}", f"ready/{k}",
+                                             timeout=self.POLL_TIMEOUT_S)
+                    missing.discard(k)
+                except Exception:
+                    continue  # straggler: keep polling this rank
+            elapsed = _time.monotonic() - start
+            if missing and elapsed - warned_at > self.stall_warning_s:
+                self._warn_stall(r, missing, elapsed)
+                warned_at = elapsed
+            if (missing and self.stall_shutdown_s > 0
+                    and elapsed > self.stall_shutdown_s):
+                self._error_close_round(r, missing, elapsed)
+                return None
+        return got if not missing else None
 
     def run(self):
         r = 0
         while not self._stop_evt.is_set():
             try:
-                for k in range(self.size):
-                    raw = self._get_with_retry(f"ctl/r{r}", f"ready/{k}")
-                    if raw is None:
-                        return  # stopping
-                    for name, sig in json.loads(raw):
+                got = self._gather_round(r)
+                if got is None:
+                    if self._stop_evt.is_set():
+                        return
+                    r += 1  # error-closed round: lockstep advances
+                    continue
+                for k in sorted(got):
+                    raw = got[k]
+                    if raw == KVController.SAME_AS_LAST:
+                        msg = self._last_submission.get(k, {"e": [], "j": False})
+                    else:
+                        msg = json.loads(raw)
+                        if isinstance(msg, list):  # tolerate bare entry lists
+                            msg = {"e": msg, "j": False}
+                        self._last_submission[k] = msg
+                    if msg.get("j") and k not in self._joined:
+                        self._joined.add(k)
+                        self._last_joined_rank = k
+                    for name, sig in msg.get("e", []):
                         self._increment(name, sig, k)
+                self._check_stalled_tensors()
+                # A tensor is ready when every rank either submitted it or
+                # has joined (joined ranks are implicit zero contributors,
+                # reference JoinOp semantics). At least one real submission
+                # is required — join alone must not fire ghost collectives.
                 ready = [n for n in self.order
-                         if len(self.table[n][1]) == self.size]
+                         if len(self.table[n][1] | self._joined) == self.size]
+                join_done = None
+                if len(self._joined) == self.size:
+                    join_done = self._last_joined_rank
+                    self._joined.clear()
+                    self._last_joined_rank = -1
+                    for k in self._last_submission.values():
+                        k["j"] = False
                 errors = {n: self.errors[n] for n in list(self.errors)}
+                sigs = {n: self.table[n][0] for n in ready}
                 for n in ready:
                     del self.table[n]
                     self.order.remove(n)
+                    self._first_seen.pop(n, None)
+                    self._stall_warned.discard(n)
                 for n in errors:
                     self.table.pop(n, None)
                     if n in self.order:
                         self.order.remove(n)
                     self.errors.pop(n, None)
+                    self._first_seen.pop(n, None)
+                    self._stall_warned.discard(n)
                 self.client.put(f"ctl/r{r}", "resp",
                                 json.dumps({"ready": ready,
-                                            "errors": errors}).encode())
+                                            "sigs": sigs,
+                                            "errors": errors,
+                                            "join_done": join_done}).encode())
                 if r >= 2:
                     self.client.delete_scope(f"ctl/r{r - 2}")
                 r += 1
@@ -176,12 +337,44 @@ class _Coordinator(threading.Thread):
                 LOG.warning("coordinator round %d error: %s", r, e)
                 return
 
+    def _check_stalled_tensors(self):
+        """Per-tensor stall attribution after a completed round: a tensor
+        submitted by some ranks but not others for longer than
+        ``stall_warning_s`` gets a warning naming the absent ranks; past
+        ``stall_shutdown_s`` it is error-closed so the submitting ranks
+        fail fast (reference CheckForStalledTensors, stall_inspector.h:39,
+        and InvalidateStalledCachedTensors)."""
+        import time as _time
+
+        now = _time.monotonic()
+        for n, (_, ranks) in list(self.table.items()):
+            if len(ranks | self._joined) == self.size or n in self.errors:
+                continue
+            age = now - self._first_seen.get(n, now)
+            missing = sorted(set(range(self.size)) - ranks - self._joined)
+            if (self.stall_shutdown_s > 0 and age > self.stall_shutdown_s):
+                self.errors[n] = (
+                    f"tensor {n!r} stalled for {age:.0f} s waiting on ranks "
+                    f"{missing}; exceeded "
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+            elif age > self.stall_warning_s and n not in self._stall_warned:
+                LOG.warning(
+                    "Tensor %r has been ready on ranks %s for %.0f s but is "
+                    "still waiting on ranks %s. One or more processes may "
+                    "have stopped submitting this collective.",
+                    n, sorted(ranks), age, missing)
+                self._stall_warned.add(n)
+                self.stall_warnings += 1
+
     def _increment(self, name: str, sig: list, rank: int):
         """IncrementTensorCount + mismatch validation (controller.cc:942,
         :471-748)."""
+        import time as _time
+
         if name not in self.table:
             self.table[name] = (sig, {rank})
             self.order.append(name)
+            self._first_seen[name] = _time.monotonic()
             return
         ref_sig, ranks = self.table[name]
         if sig != ref_sig:

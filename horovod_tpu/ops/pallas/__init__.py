@@ -1,1 +1,8 @@
+"""Pallas TPU kernels (SURVEY.md §5.7): fused block attention for the
+sequence-parallel path."""
 
+from .flash_attention import (  # noqa: F401
+    attention_stats,
+    flash_attention,
+    flash_attention_stats,
+)

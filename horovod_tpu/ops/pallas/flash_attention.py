@@ -1,0 +1,235 @@
+"""Fused block (flash) attention — the Pallas TPU kernel behind
+`horovod_tpu.parallel.sp.ring_attention`'s inner step (SURVEY.md §5.7
+"pallas splash-attention kernels"; greenfield — the reference has no
+attention kernels at all).
+
+Forward is a single Pallas kernel: for each Q block the K/V blocks stream
+through VMEM while an online softmax (running max ``m``, running sum ``l``,
+rescaled accumulator) lives in VMEM scratch — logits never round-trip to
+HBM, which is the whole point on a bandwidth-bound chip. The kernel also
+returns ``(m, l)`` so ring attention can combine partial results from
+other chips' K/V shards exactly.
+
+Backward is a rematerialized standard attention VJP in plain XLA ops
+(saved q/k/v + the forward's logsumexp): correct and memory-light per
+block pair; a fused backward kernel is a later optimization.
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests on the
+virtual CPU mesh), so one code path serves everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                      acc_scr, m_scr, l_scr, *, scale: float, causal: bool,
+                      block_q: int, block_k: int, num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _block():
+        q = q_ref[0]                      # [bq, d]
+        k = k_ref[0]                      # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    if causal:
+        # skip blocks strictly above the diagonal (their mask is empty)
+        @pl.when(ki * block_k < (qi + 1) * block_q)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        # guard fully-masked rows (l == 0 never happens when causal includes
+        # the diagonal, but ring callers may pass degenerate blocks)
+        l = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    """q: [B, sq, d], k/v: [B, sk, d] → (o [B, sq, d], m [B, sq], l [B, sq]).
+
+    o is *normalized* (already divided by l); combining across ring steps
+    uses (m, l) to undo/redo normalization exactly.
+    """
+    B, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(
+            f"sequence lengths ({sq}, {sk}) must be divisible by the block "
+            f"sizes ({bq}, {bk}); pick block_q/block_k that tile the "
+            "sequence or use the XLA fallback (_lax_stats)")
+    nq, nk = sq // bq, sk // bk
+    scale = d ** -0.5
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, num_k_blocks=nk)
+    from jax.experimental.pallas import tpu as pltpu
+
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=(B, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((B, sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, m, l
+
+
+def _reference_attention(q, k, v, causal: bool):
+    """Plain XLA attention used by the backward rematerialization and as
+    the numerics oracle in tests. q/k/v: [B, s, d]."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 512):
+    """Fused attention: q [B, sq, d] × k/v [B, sk, d] → [B, sq, d]."""
+    o, _, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return o
+
+
+def flash_attention_stats(q, k, v, causal: bool = True, block_q: int = 512,
+                          block_k: int = 512):
+    """Forward returning (o, m, l) for cross-chip (ring) combination."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _lax_stats(q, k, v, causal: bool):
+    """Pure-XLA stats attention: (normalized o, running max m, sum l) in the
+    same contract as the Pallas kernel. Serves as the differentiable
+    fallback (non-TPU backends) and the autodiff oracle for the kernel's
+    rematerialized VJP."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v).astype(jnp.float32)
+    o = (o / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+    return o, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def attention_stats(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 512):
+    """Differentiable stats attention: Pallas kernel on TPU for the primal,
+    rematerialized XLA VJP for the backward (cotangents of o, m, l all
+    handled — ring combination makes m and l real outputs, not residuals).
+    """
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _stats_fwd(q, k, v, causal, block_q, block_k):
+    out = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _stats_bwd(causal, block_q, block_k, res, cts):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _lax_stats(a, b, c, causal), q, k, v)
+    return vjp(cts)
+
+
+attention_stats.defvjp(_stats_fwd, _stats_bwd)
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    o, m, l = _flash_fwd(q, k, v, causal, block_q, block_k)
+    # logsumexp per row: enough to rebuild p exactly in the backward
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+    return o, (q, k, v, o, lse)
+
+
+def _bwd(causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                       # [B, sq, sk] f32
+    do_f = do.astype(jnp.float32)
+    o_f = o.astype(jnp.float32)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do_f)
+    dp = jnp.einsum("bqd,bkd->bqk", do_f, v.astype(jnp.float32))
+    delta = jnp.sum(do_f * o_f, axis=-1)                  # [B, sq]
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -169,6 +169,11 @@ class BackgroundRuntime:
         self.stall = stall_inspector
         self.queue = TensorQueue()
         self.handles = HandleManager()
+        # fusion pack helper (reference fusion_buffer_manager.h:40);
+        # native batched-memcpy when the C++ core is built
+        from .._native import FusionBuffer
+
+        self.fusion_buffer = FusionBuffer()
         self._pending: dict[str, TensorEntry] = {}  # negotiated-path backlog
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -179,7 +184,18 @@ class BackgroundRuntime:
         self.cycles = 0
         self.work_cycles = 0
         self.autotuner = None  # attached by context.init when HOROVOD_AUTOTUNE
+        # join state (reference JoinOp / hvd.join(): a rank out of data keeps
+        # participating in other ranks' collectives with zero contributions
+        # until everyone has joined)
+        self.joined = False
+        self._join_done_evt = threading.Event()
+        self._join_last_rank = -1
         self.controller = self._maybe_controller()
+        if self.controller is not None and self.stall is not None:
+            # multi-process: the coordinator owns stall *shutdown* (it can
+            # attribute the missing ranks — reference stall_inspector runs
+            # coordinator-side); the local inspector keeps the warning role
+            self.stall.shutdown_time_s = 0.0
 
     def _maybe_controller(self):
         """Cross-process negotiation over the launcher's rendezvous store —
@@ -201,9 +217,18 @@ class BackgroundRuntime:
         from ..runner.http_server import KVStoreClient
         from .controller import KVController
 
+        from ..common import context as ctx_mod
+
+        try:
+            cfg = ctx_mod.context().config
+            warn_s, shut_s = cfg.stall_warning_time_s, cfg.stall_shutdown_time_s
+        except Exception:
+            warn_s, shut_s = 60.0, 0.0
         return KVController(KVStoreClient(addr, int(port)),
                             rank=self.process_set.cross_rank,
-                            size=self.process_set.cross_size)
+                            size=self.process_set.cross_size,
+                            stall_warning_s=warn_s,
+                            stall_shutdown_s=shut_s)
 
     # -- public enqueue API -------------------------------------------------
     def enqueue(self, entry: TensorEntry) -> int:
@@ -259,9 +284,28 @@ class BackgroundRuntime:
             try:
                 self.stall.check()
             except Exception as e:
+                # Fail exactly the stalled entries and keep the cycle loop
+                # alive: a dead loop would stop negotiation rounds and
+                # deadlock every healthy rank (reference behavior: stall
+                # shutdown aborts the affected tensors/job, the background
+                # thread itself keeps servicing its queue until shutdown).
+                names = getattr(e, "names", None)
+                if names is None:  # unknown failure: fail this batch
+                    for entry in batch:
+                        self._finish(entry, None, e)
+                    raise
+                err = HorovodInternalError(str(e))
+                remaining = []
                 for entry in batch:
-                    self._finish(entry, None, e)
-                raise
+                    if entry.name in names:
+                        self._finish(entry, None, err)
+                    else:
+                        remaining.append(entry)
+                batch = remaining
+                for n in names:
+                    entry = self._pending.pop(n, None)
+                    if entry is not None:
+                        self._finish(entry, None, err)
         if self.controller is not None:
             batch = self._negotiate(batch)
         elif self.process_set.cross_size > 1 and batch:
@@ -287,7 +331,8 @@ class BackgroundRuntime:
         # autotune sampling on working cycles (reference: ParameterManager
         # scores each cycle's bytes/sec, parameter_manager.h:88)
         self.work_cycles += 1
-        if self.autotuner is not None and self.work_cycles % 20 == 0:
+        steps = getattr(self, "autotune_steps_per_sample", 20)
+        if self.autotuner is not None and self.work_cycles % steps == 0:
             try:
                 self.autotuner.sample()
             except Exception:
@@ -305,7 +350,8 @@ class BackgroundRuntime:
             self._pending[e.name] = e
         sigs = {n: entry_signature(e) for n, e in self._pending.items()}
         try:
-            ready, errors = self.controller.negotiate(sigs)
+            resp = self.controller.negotiate(sigs, joined=self.joined)
+            ready, errors = resp["ready"], resp["errors"]
         except Exception as exc:
             # Fail everything — including on shutdown: a silent return would
             # leak handles a caller may be blocked on in hvd.wait().
@@ -323,7 +369,50 @@ class BackgroundRuntime:
             e = self._pending.pop(n, None)
             if e is not None:
                 self._finish(e, None, HorovodInternalError(msg))
-        return [self._pending.pop(n) for n in ready if n in self._pending]
+        out = []
+        for n in ready:
+            if n in self._pending:
+                out.append(self._pending.pop(n))
+            elif self.joined:
+                # fabricate a zero contribution from the coordinator's
+                # signature (reference: joined ranks contribute zeros,
+                # global_state.h:107-111). handle=-1: no caller is waiting.
+                sig = resp["sigs"].get(n)
+                if sig is not None:
+                    out.append(self._zero_entry_from_sig(n, sig))
+        if resp.get("join_done") is not None:
+            self._join_last_rank = int(resp["join_done"])
+            self.joined = False
+            self._join_done_evt.set()
+        return out
+
+    @staticmethod
+    def _zero_entry_from_sig(name: str, sig: list) -> TensorEntry:
+        """Build a zero-valued TensorEntry matching another rank's submitted
+        signature ([op, dtype, shape, reduce_op, root, pre, post, ps, dev]).
+        Allgather contributes an empty first dim (ragged support makes the
+        zero-row contribution exact, not padded)."""
+        op, dtype, shape = sig[0], sig[1], list(sig[2])
+        if op == "allgather" and shape:
+            shape[0] = 0
+        return TensorEntry(
+            name=name, op=op, tensor=np.zeros(shape, dtype=np.dtype(dtype)),
+            reduce_op=C.ReduceOp(sig[3]), root_rank=sig[4],
+            prescale_factor=sig[5], postscale_factor=sig[6])
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        """Reference hvd.join(): mark this rank out of data, keep
+        contributing zeros to other ranks' collectives, block until every
+        rank has joined; returns the last rank to join."""
+        if self.controller is None:
+            return self.process_set.rank
+        self._join_done_evt.clear()
+        self.joined = True
+        self._wake.set()
+        if not self._join_done_evt.wait(timeout or 600.0):
+            self.joined = False
+            raise HorovodInternalError("join() timed out waiting for all ranks")
+        return self._join_last_rank
 
     # -- execution -----------------------------------------------------------
     def _finish(self, entry: TensorEntry, result, exc=None):
@@ -358,18 +447,22 @@ class BackgroundRuntime:
                     self.timeline.start_activity(n, "FUSED_ALLREDUCE")
             try:
                 arrs = [np.asarray(e.tensor) for e in chunk]
-                flats = [a.ravel() for a in arrs]
-                sizes = [f.size for f in flats]
-                fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
+                if len(arrs) > 1:
+                    fused = self.fusion_buffer.pack(arrs)
+                else:
+                    fused = arrs[0].ravel()
                 e0 = chunk[0]
                 red = C._eager_allreduce(
                     fused, e0.reduce_op, e0.process_set or self.process_set,
                     e0.prescale_factor, e0.postscale_factor)
                 self.bytes_processed += fused.nbytes
+                # results stay device-side lazy slices: the cycle thread
+                # must not block on completion (async contract; callers
+                # observe readiness per-handle)
                 off = 0
-                for e, a, n in zip(chunk, arrs, sizes):
-                    self._finish(e, red[off:off + n].reshape(a.shape))
-                    off += n
+                for e, a in zip(chunk, arrs):
+                    self._finish(e, red[off:off + a.size].reshape(a.shape))
+                    off += a.size
             except Exception as exc:  # fail the whole chunk
                 for e in chunk:
                     self._finish(e, None,

@@ -5,11 +5,14 @@ its only adjacent machinery is the alltoall primitive. Here both standard
 SP schemes are first-class, built on the mesh 'sp' axis:
 
 - **Ring attention** (`ring_attention`): K/V blocks rotate around the ring
-  via ``lax.ppermute`` (ICI neighbor exchange) while each chip accumulates
-  flash-style online-softmax statistics for its resident Q block. Causal
-  masking is done per block pair, so each chip does only the work its
-  Q-block needs. Communication is overlapped with the block computation by
-  XLA's latency-hiding scheduler.
+  via ``lax.ppermute`` (ICI neighbor exchange) under a single
+  ``lax.scan`` — program size and compile time are O(1) in ring size (a
+  rolled loop, not n unrolled copies), and the K/V permute for step r+1
+  overlaps with step r's block compute under XLA's latency-hiding
+  scheduler. The inner step is the fused Pallas flash-attention kernel
+  (`horovod_tpu.ops.pallas.attention_stats`) on TPU, with a pure-XLA
+  fallback elsewhere; both return (o, m, l) online-softmax stats that the
+  ring combines exactly.
 - **Ulysses** (`ulysses_attention`): two ``all_to_all`` reshuffles trade
   the sequence sharding for a head sharding around the attention core
   (DeepSpeed-Ulysses style, built on the same primitive the reference
@@ -28,56 +31,78 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_attn_stats(q, k, v, mask):
-    """One flash block: masked logits → (new partial max, exp-weights sums,
-    weighted values). q/k/v: [b, s, h, hd]; mask broadcastable [s, t]."""
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bshk,bthk->bhst", q, k).astype(jnp.float32) * scale
-    logits = jnp.where(mask, logits, NEG_INF)
-    m = jnp.max(logits, axis=-1)  # [b,h,s]
-    p = jnp.exp(logits - m[..., None])
-    l = jnp.sum(p, axis=-1)  # [b,h,s]
-    o = jnp.einsum("bhst,bthk->bshk", p.astype(v.dtype), v).astype(jnp.float32)
-    return m, l, o
-
-
-def ring_attention(q, k, v, axis_name: str = "sp"):
+def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
+                   block_q: int = 512, block_k: int = 512):
     """Causal ring attention over the 'sp' axis.
 
-    Sequence is block-sharded: chip i holds tokens
-    [i*s_loc, (i+1)*s_loc). Returns the attention output for the local
-    Q block, same shape/dtype as q.
+    Sequence is block-sharded: chip i holds tokens [i*s_loc, (i+1)*s_loc).
+    Returns the attention output for the local Q block, same shape/dtype
+    as q ([batch, s_loc, heads, head_dim]).
+
+    ``use_flash=None`` auto-selects the Pallas kernel on TPU and the
+    differentiable XLA fallback elsewhere.
     """
+    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
+
     n = lax.axis_size(axis_name)
     i = lax.axis_index(axis_name)
-    s = q.shape[1]
-    b, h = q.shape[0], q.shape[2]
-    tril = jnp.tril(jnp.ones((s, s), bool))
+    b, s, h, d = q.shape
+    if use_flash is None:
+        # kernel blocks must tile the local sequence exactly; fall back to
+        # the XLA stats path for shapes that don't (no silent crash for
+        # non-power-of-two shards)
+        use_flash = (jax.default_backend() == "tpu"
+                     and s % min(block_q, s) == 0
+                     and s % min(block_k, s) == 0)
+    # kernel layout: [B=b*h, s, d]
+    def to_flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    m_acc = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l_acc = jnp.zeros((b, h, s), jnp.float32)
-    o_acc = jnp.zeros(q.shape[:1] + (s,) + q.shape[2:], jnp.float32)
-
+    qf = to_flat(q)
     perm = [(x, (x + 1) % n) for x in range(n)]
-    for r in range(n):
+
+    def stats(kf, vf, causal: bool):
+        if use_flash:
+            return attention_stats(qf, kf, vf, causal, block_q, block_k)
+        return _lax_stats(qf, kf, vf, causal)
+
+    def round_fn(carry, r):
+        kf, vf, m_acc, l_acc, o_acc = carry
         j = (i - r) % n  # source block index of the K/V currently resident
-        # causal block mask: full if j<i, triangular if j==i, empty if j>i.
-        # Round 0 is the diagonal block, so every row sees >=1 real entry
-        # before any fully-masked round — keeps the online softmax finite.
-        block_mask = jnp.where(j == i, tril, (j < i))
-        m_r, l_r, o_r = _block_attn_stats(q, k, v, block_mask)
+        # causal block cases: diagonal (r==0) → triangular; j<i → full;
+        # j>i → skip (entirely masked). Round 0 is the diagonal, so every
+        # row sees ≥1 real entry before any skip round — the online
+        # softmax stays finite.
+        branch = jnp.where(r == 0, 0, jnp.where(j < i, 1, 2))
+        o_r, m_r, l_r = lax.switch(branch, [
+            lambda kv: stats(kv[0], kv[1], True),
+            lambda kv: stats(kv[0], kv[1], False),
+            # pvary: constants are replication-typed; the other branches'
+            # outputs vary over the sp axis, and switch demands equal types
+            lambda kv: (jnp.zeros_like(qf),
+                        lax.pvary(jnp.full((b * h, s), NEG_INF, jnp.float32),
+                                  axis_name),
+                        lax.pvary(jnp.zeros((b * h, s), jnp.float32),
+                                  axis_name)),
+        ], (kf, vf))
         m_new = jnp.maximum(m_acc, m_r)
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m_r - m_new)
-        l_acc = l_acc * alpha + l_r * beta
-        o_acc = (o_acc * alpha.transpose(0, 2, 1)[..., None]
-                 + o_r * beta.transpose(0, 2, 1)[..., None])
-        m_acc = m_new
-        if r != n - 1:
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
-    out = o_acc / l_acc.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+        l_new = l_acc * alpha + l_r * beta
+        # o_r is normalized by l_r: un-normalize before combining
+        o_acc = (o_acc * alpha[..., None]
+                 + o_r.astype(jnp.float32) * (l_r * beta)[..., None])
+        kf = lax.ppermute(kf, axis_name, perm)
+        vf = lax.ppermute(vf, axis_name, perm)
+        return (kf, vf, m_new, l_new, o_acc), None
+
+    init = (to_flat(k), to_flat(v),
+            lax.pvary(jnp.full((b * h, s), NEG_INF, jnp.float32), axis_name),
+            lax.pvary(jnp.zeros((b * h, s), jnp.float32), axis_name),
+            lax.pvary(jnp.zeros((b * h, s, d), jnp.float32), axis_name))
+    (_, _, _, l_acc, o_acc), _ = lax.scan(round_fn, init, jnp.arange(n))
+    out = o_acc / jnp.where(l_acc == 0.0, 1.0, l_acc)[..., None]
+    return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", attn_fn=None):

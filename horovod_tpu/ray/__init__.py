@@ -1,0 +1,21 @@
+"""horovod_tpu.ray — Ray cluster integration (reference horovod/ray/).
+
+`RayExecutor` places one worker per slot across the cluster, computes the
+rank/topology env for each (reference runner.py:176 Coordinator +
+NodeColocator :100), starts the rendezvous KV server, and runs user
+functions on all workers.
+
+TPU-shaped differences: workers bootstrap through
+``jax.distributed.initialize`` + the HTTP rendezvous store (no Gloo, no
+NIC negotiation), and the executor is built over a small engine
+abstraction — `RayEngine` drives real Ray actors when ray is installed;
+`LocalProcessEngine` drives local subprocesses so placement/topology logic
+stays hermetically testable without a Ray cluster (the reference tests
+against ``ray.init(local)``; this image has no ray wheel at all).
+"""
+
+from .runner import (  # noqa: F401
+    Coordinator,
+    LocalProcessEngine,
+    RayExecutor,
+)

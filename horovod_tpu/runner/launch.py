@@ -56,7 +56,11 @@ def slot_env(slot: SlotInfo, rendezvous_addr: str, rendezvous_port: int,
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(horovod_tpu.__file__)))
     pythonpath = e.get("PYTHONPATH", "")
     if pkg_root not in pythonpath.split(os.pathsep):
-        e["PYTHONPATH"] = (pkg_root + os.pathsep + pythonpath).rstrip(os.pathsep)
+        # append the separator only when there was a PYTHONPATH: a blanket
+        # rstrip would also drop a user's meaningful trailing empty entry
+        # (empty entry = cwd)
+        e["PYTHONPATH"] = pkg_root + (os.pathsep + pythonpath
+                                      if pythonpath else "")
     e.update({
         env_schema.HOROVOD_RANK: str(slot.rank),
         env_schema.HOROVOD_SIZE: str(slot.size),

@@ -1,0 +1,1 @@
+from .store import FilesystemStore, HDFSStore, LocalStore, Store  # noqa: F401

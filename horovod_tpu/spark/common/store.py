@@ -1,0 +1,154 @@
+"""Training-artifact store abstraction (reference
+/root/reference/horovod/spark/common/store.py:32 Store / :157
+FilesystemStore/LocalStore/HDFSStore).
+
+Original slim implementation: the store maps (run_id, dataset index) to
+paths for intermediate data, checkpoints and logs on a filesystem-like
+backend. The local filesystem backend is fully functional (and is what the
+TPU estimator uses for orbax/np checkpoints); an HDFS backend is gated on
+pyarrow having HDFS support in the environment.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Abstract path layout + object IO for estimator runs."""
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes):
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str):
+        """Factory (reference store.py Store.create): pick a backend from
+        the path scheme."""
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path)
+        return FilesystemStore(prefix_path)
+
+
+class FilesystemStore(Store):
+    """Local/NFS filesystem layout (reference FilesystemStore :157)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = os.path.abspath(prefix_path)
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        sub = "intermediate_train_data" + ("" if idx is None else f".{idx}")
+        return os.path.join(self.prefix_path, sub)
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        sub = "intermediate_val_data" + ("" if idx is None else f".{idx}")
+        return os.path.join(self.prefix_path, sub)
+
+    def get_runs_path(self) -> str:
+        return os.path.join(self.prefix_path, "runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.get_runs_path(), run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def cleanup_run(self, run_id: str):
+        shutil.rmtree(self.get_run_path(run_id), ignore_errors=True)
+
+
+class LocalStore(FilesystemStore):
+    """Alias of FilesystemStore (reference LocalStore)."""
+
+
+class HDFSStore(Store):
+    """HDFS-backed store via pyarrow (reference HDFSStore). Gated: raises
+    at construction when the environment has no HDFS support."""
+
+    def __init__(self, prefix_path: str, host: str = "default",
+                 port: int = 0, user: Optional[str] = None):
+        try:
+            from pyarrow import fs as pafs
+
+            self._fs = pafs.HadoopFileSystem(host=host, port=port, user=user)
+        except Exception as e:
+            raise ImportError(
+                "HDFSStore requires pyarrow with libhdfs support; use "
+                "FilesystemStore for local/NFS paths") from e
+        self.prefix_path = prefix_path
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        sub = "intermediate_train_data" + ("" if idx is None else f".{idx}")
+        return f"{self.prefix_path}/{sub}"
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        sub = "intermediate_val_data" + ("" if idx is None else f".{idx}")
+        return f"{self.prefix_path}/{sub}"
+
+    def get_runs_path(self) -> str:
+        return f"{self.prefix_path}/runs"
+
+    def get_run_path(self, run_id: str) -> str:
+        return f"{self.get_runs_path()}/{run_id}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/checkpoint"
+
+    def get_logs_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/logs"
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        return self._fs.get_file_info(path).type != pafs.FileType.NotFound
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes):
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)

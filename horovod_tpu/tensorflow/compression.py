@@ -1,0 +1,58 @@
+"""Gradient compression for the TensorFlow API.
+
+Reference: /root/reference/horovod/tensorflow/compression.py — a
+`Compressor` with ``none``/``fp16`` cast-on-the-wire implementations. Here
+``bf16`` is added as the TPU-native 16-bit format (MXU-consumable).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = tf.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating and tensor.dtype != cls.wire_dtype:
+            return tf.cast(tensor, cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = tf.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = tf.bfloat16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,52 @@
+"""Elastic state handlers for TF/Keras (reference
+horovod/tensorflow/elastic.py — TensorFlowState/TensorFlowKerasState):
+snapshot/restore/broadcast of variables so elastic restarts resume from
+committed state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import horovod_tpu as _core
+from horovod_tpu.elastic.state import ObjectState
+
+from .functions import broadcast_variables
+
+
+class TensorFlowState(ObjectState):
+    """Tracks a list of tf.Variables (reference elastic.py TensorFlowState).
+    commit() snapshots values host-side; restore() assigns them back;
+    sync() broadcasts from rank 0."""
+
+    def __init__(self, variables=None, **kwargs):
+        self._variables = list(variables or [])
+        self._tf_saved = None
+        super().__init__(**kwargs)
+
+    def save(self):
+        self._tf_saved = [np.asarray(v.numpy()) for v in self._variables]
+        super().save()
+
+    def restore(self):
+        if self._tf_saved is not None:
+            for v, s in zip(self._variables, self._tf_saved):
+                v.assign(s)
+        super().restore()
+
+    def sync(self):
+        if self._variables and _core.cross_size() > 1:
+            broadcast_variables(self._variables, root_rank=0)
+        super().sync()
+
+
+class TensorFlowKerasState(TensorFlowState):
+    """Model+optimizer variant (reference TensorFlowKerasState)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        variables = list(model.variables)
+        if self.optimizer is not None:
+            variables += list(getattr(self.optimizer, "variables", []) or [])
+        super().__init__(variables=variables, **kwargs)

@@ -1,0 +1,57 @@
+"""TF variable/object broadcast helpers.
+
+Reference: /root/reference/horovod/tensorflow/functions.py —
+``broadcast_variables`` (:47), ``broadcast_object``/``broadcast_object_fn``
+and ``allgather_object``. Variables are assigned in place from the
+root's values; objects ride the core's pickle-based collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _core
+
+
+def broadcast_variables(variables, root_rank: int = 0,
+                        process_set=None, inplace: bool = False):
+    """Assign every variable the root rank's value (reference
+    functions.py:47). Called once after init / checkpoint restore so all
+    workers start identically."""
+    handles = []
+    for i, v in enumerate(variables):
+        # index-prefixed: Keras 3 variable names are not unique ("bias"
+        # repeats across layers) and in-flight names must be
+        name = f"bcast.tf.{i}.{getattr(v, 'name', '') or 'var'}"
+        h = _core.broadcast_async(v.numpy(), root_rank, name,
+                                  process_set=process_set)
+        handles.append((v, h))
+    for v, h in handles:
+        cur = np.asarray(v)  # works for tf.Variable and Keras 3 variables
+        v.assign(np.asarray(_core.synchronize(h)).astype(
+            cur.dtype).reshape(cur.shape))
+
+
+def broadcast_object(obj, root_rank: int = 0, session=None, name=None,
+                     process_set=None):
+    return _core.broadcast_object(obj, root_rank=root_rank,
+                                  process_set=process_set)
+
+
+def broadcast_object_fn(root_rank: int = 0, session=None, name=None,
+                        process_set=None):
+    """Reference functions.py broadcast_object_fn: a callable for repeated
+    broadcasts (TF1 session compatibility shape)."""
+
+    def fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+
+    return fn
+
+
+def allgather_object(obj, session=None, name=None, process_set=None):
+    return _core.allgather_object(obj, process_set=process_set)

@@ -1,0 +1,29 @@
+"""horovod_tpu.tensorflow.keras — tf.keras-facing API (reference
+horovod/tensorflow/keras/__init__.py); shares the implementation with
+horovod_tpu.keras (both front Keras 3)."""
+
+from horovod_tpu.keras import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    DistributedOptimizer,
+    Sum,
+    allgather_object,
+    broadcast_object,
+    broadcast_variables,
+    callbacks,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    load_model,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.tensorflow.elastic import (  # noqa: F401
+    TensorFlowKerasState,
+    TensorFlowState,
+)

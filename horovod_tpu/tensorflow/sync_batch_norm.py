@@ -1,0 +1,77 @@
+"""Cross-worker synchronized BatchNormalization for TF/Keras models.
+
+Reference: /root/reference/horovod/tensorflow/sync_batch_norm.py — batch
+statistics are averaged across all workers each step (crucial for small
+per-worker batches). Implemented as a standalone Keras layer (Keras 3's
+BatchNormalization internals are not a stable override surface): local
+mean / mean-of-squares are allreduce-averaged through the eager runtime
+via ``tf.py_function`` so it also works under ``tf.function`` tracing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _core
+
+
+class SyncBatchNormalization(tf.keras.layers.Layer):
+    def __init__(self, axis: int = -1, momentum: float = 0.99,
+                 epsilon: float = 1e-3, name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.axis = axis
+        self.momentum = momentum
+        self.epsilon = epsilon
+
+    def build(self, input_shape):
+        dim = int(input_shape[self.axis])
+        self.gamma = self.add_weight(name="gamma", shape=(dim,),
+                                     initializer="ones", trainable=True)
+        self.beta = self.add_weight(name="beta", shape=(dim,),
+                                    initializer="zeros", trainable=True)
+        self.moving_mean = self.add_weight(
+            name="moving_mean", shape=(dim,), initializer="zeros",
+            trainable=False)
+        self.moving_variance = self.add_weight(
+            name="moving_variance", shape=(dim,), initializer="ones",
+            trainable=False)
+        super().build(input_shape)
+
+    @staticmethod
+    def _global_moments(mean, meansq):
+        """Average local [mean, mean-of-squares] across workers (reference
+        sync_batch_norm.py's allreduce of statistics)."""
+        if _core.cross_size() <= 1:
+            return mean, meansq
+
+        def _reduce(m, ms):
+            stacked = np.stack([m.numpy(), ms.numpy()])
+            out = _core.synchronize(_core.allreduce_async(
+                stacked, average=True, name="sync_bn.moments"))
+            out = np.asarray(out)
+            return out[0].astype(np.float32), out[1].astype(np.float32)
+
+        gm, gms = tf.py_function(_reduce, [mean, meansq],
+                                 [tf.float32, tf.float32])
+        gm.set_shape(mean.shape)
+        gms.set_shape(meansq.shape)
+        return tf.cast(gm, mean.dtype), tf.cast(gms, meansq.dtype)
+
+    def call(self, inputs, training=False):
+        reduce_axes = [i for i in range(inputs.shape.rank)
+                       if i != (self.axis % inputs.shape.rank)]
+        if training:
+            mean = tf.reduce_mean(inputs, axis=reduce_axes)
+            meansq = tf.reduce_mean(tf.square(inputs), axis=reduce_axes)
+            mean, meansq = self._global_moments(mean, meansq)
+            var = meansq - tf.square(mean)
+            self.moving_mean.assign(
+                self.momentum * self.moving_mean + (1 - self.momentum) * mean)
+            self.moving_variance.assign(
+                self.momentum * self.moving_variance
+                + (1 - self.momentum) * var)
+        else:
+            mean, var = self.moving_mean, self.moving_variance
+        return tf.nn.batch_normalization(
+            inputs, mean, var, self.beta, self.gamma, self.epsilon)

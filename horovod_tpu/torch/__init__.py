@@ -93,55 +93,110 @@ def _np_to_torch(result, dtype=None) -> torch.Tensor:
 def _result_tensor(handle: int, result) -> torch.Tensor:
     target, dtype = _handle_meta.pop(handle, (None, None))
     if target is not None:
-        out = torch.from_numpy(np.asarray(result))
+        # In-place path only *reads* the intermediate, but from_numpy on a
+        # read-only view (results can be views of the shared fused buffer)
+        # emits a UserWarning per collective — copy only when needed.
+        arr = np.asarray(result)
+        if not arr.flags.writeable:
+            arr = arr.copy()
+        out = torch.from_numpy(arr)
         target.copy_(out.to(target.dtype).reshape(target.shape))
         return target
     return _np_to_torch(result, dtype)
 
 
-# --- async ops (reference mpi_ops.py:95-560) --------------------------------
+# --- async ops (reference mpi_ops.py:95-560; process_set kwarg matches
+# post-v0.21 Horovod's process-set support) ----------------------------------
 
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None) -> int:
     h = _core.allreduce_async(_to_np(tensor), average, name, op=op,
                               prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor)
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
     _handle_meta[h] = (None, tensor.dtype)
     return h
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,
-                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None) -> int:
     h = _core.allreduce_async(_to_np(tensor), average, name, op=op,
                               prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor)
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
     _handle_meta[h] = (tensor, tensor.dtype)
     return h
 
 
-def allgather_async(tensor, name=None) -> int:
-    h = _core.allgather_async(_to_np(tensor), name)
+def allgather_async(tensor, name=None, process_set=None) -> int:
+    h = _core.allgather_async(_to_np(tensor), name, process_set=process_set)
     _handle_meta[h] = (None, tensor.dtype)
     return h
 
 
-def broadcast_async(tensor, root_rank, name=None) -> int:
-    h = _core.broadcast_async(_to_np(tensor), root_rank, name)
+def broadcast_async(tensor, root_rank, name=None, process_set=None) -> int:
+    h = _core.broadcast_async(_to_np(tensor), root_rank, name,
+                              process_set=process_set)
     _handle_meta[h] = (None, tensor.dtype)
     return h
 
 
-def broadcast_async_(tensor, root_rank, name=None) -> int:
-    h = _core.broadcast_async(_to_np(tensor), root_rank, name)
+def broadcast_async_(tensor, root_rank, name=None, process_set=None) -> int:
+    h = _core.broadcast_async(_to_np(tensor), root_rank, name,
+                              process_set=process_set)
     _handle_meta[h] = (tensor, tensor.dtype)
     return h
 
 
-def alltoall_async(tensor, splits=None, name=None) -> int:
+def alltoall_async(tensor, splits=None, name=None, process_set=None) -> int:
     h = _core.alltoall_async(_to_np(tensor),
-                             None if splits is None else _to_np(splits), name)
+                             None if splits is None else _to_np(splits), name,
+                             process_set=process_set)
     _handle_meta[h] = (None, tensor.dtype)
     return h
+
+
+def reducescatter_async(tensor, name=None, op=None, process_set=None) -> int:
+    """Reduce-scatter along dim 0 (reference torch/mpi_ops.py reducescatter
+    in post-v0.21 releases)."""
+    h = _core.reducescatter_async(_to_np(tensor), name, op=op,
+                                  process_set=process_set)
+    _handle_meta[h] = (None, tensor.dtype)
+    return h
+
+
+import itertools
+
+_group_counter = itertools.count()
+
+
+def _group_base(name):
+    # unique per unnamed call (reference "grouped_allreduce.noname.<n>"):
+    # concurrent unnamed groups must not collide on in-flight names
+    return name or f"grouped_allreduce.noname.{next(_group_counter)}"
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=None) -> list:
+    """One logical fused op over a list (reference torch/mpi_ops.py:345):
+    the cycle loop fuses the group into a single flat collective."""
+    base = _group_base(name)
+    return [allreduce_async(t, average, f"{base}.{i}", op,
+                            prescale_factor, postscale_factor, process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set=None) -> list:
+    """In-place grouped variant (reference torch/mpi_ops.py:444)."""
+    base = _group_base(name)
+    return [allreduce_async_(t, average, f"{base}.{i}", op,
+                             prescale_factor, postscale_factor, process_set)
+            for i, t in enumerate(tensors)]
 
 
 def poll(handle: int) -> bool:
@@ -168,33 +223,59 @@ def synchronize(handle: int):
 
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none,
-              prescale_factor=1.0, postscale_factor=1.0):
+              prescale_factor=1.0, postscale_factor=1.0, process_set=None):
     t, ctx = compression.compress(tensor)
     out = synchronize(allreduce_async(t, average, name, op, prescale_factor,
-                                      postscale_factor))
+                                      postscale_factor, process_set))
     return compression.decompress(out, ctx)
 
 
 def allreduce_(tensor, average=None, name=None, op=None,
-               prescale_factor=1.0, postscale_factor=1.0):
+               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
     return synchronize(allreduce_async_(tensor, average, name, op,
-                                        prescale_factor, postscale_factor))
+                                        prescale_factor, postscale_factor,
+                                        process_set))
 
 
-def allgather(tensor, name=None):
-    return synchronize(allgather_async(tensor, name))
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      compression=Compression.none,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
+    comp = [compression.compress(t) for t in tensors]
+    hs = grouped_allreduce_async([c[0] for c in comp], average, name, op,
+                                 prescale_factor, postscale_factor,
+                                 process_set)
+    return [compression.decompress(synchronize(h), c[1])
+            for h, c in zip(hs, comp)]
 
 
-def broadcast(tensor, root_rank, name=None):
-    return synchronize(broadcast_async(tensor, root_rank, name))
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=None):
+    hs = grouped_allreduce_async_(tensors, average, name, op,
+                                  prescale_factor, postscale_factor,
+                                  process_set)
+    return [synchronize(h) for h in hs]
 
 
-def broadcast_(tensor, root_rank, name=None):
-    return synchronize(broadcast_async_(tensor, root_rank, name))
+def allgather(tensor, name=None, process_set=None):
+    return synchronize(allgather_async(tensor, name, process_set))
 
 
-def alltoall(tensor, splits=None, name=None):
-    return synchronize(alltoall_async(tensor, splits, name))
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def broadcast_(tensor, root_rank, name=None, process_set=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name, process_set))
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+def reducescatter(tensor, name=None, op=None, process_set=None):
+    return synchronize(reducescatter_async(tensor, name, op, process_set))
 
 
 def sparse_allreduce_async(tensor, name, op=Average):
@@ -276,6 +357,18 @@ class _DistributedMixin:
         self._should_sync = True
         self._hook_handles = []
         if named_parameters is not None:
+            seen, dups = set(), set()
+            for n, _ in named_parameters:
+                if n in seen:
+                    dups.add(n)
+                seen.add(n)
+            if dups:
+                # duplicate names would issue allreduces under the same
+                # negotiation name and mis-fuse across ranks (reference
+                # optimizer.py find_duplicates raises the same way)
+                raise ValueError(
+                    "named_parameters contains duplicate names: "
+                    f"{sorted(dups)}")
             names = {p: n for n, p in named_parameters}
             all_params = {p for g in self.param_groups for p in g["params"]}
             missing = all_params - names.keys()
@@ -313,6 +406,24 @@ class _DistributedMixin:
         self._handles[p] = (h, ctx)
 
     def synchronize(self):
+        # Reference optimizer.py synchronize(): every tracked param without
+        # a pending handle gets an allreduce now — hooks that never fired
+        # (dynamically-unused params) contribute zeros, so all ranks submit
+        # the same collective set and the negotiation can't mismatch/hang —
+        # and accumulation counters reset so a mid-window step() doesn't
+        # leave stale pass counts.
+        for p, name in self._names.items():
+            if not p.requires_grad or p in self._handles:
+                continue
+            if p.grad is None:
+                p.grad = torch.zeros_like(p)
+            comp, ctx = self._compression.compress(p.grad)
+            h = allreduce_async(comp, name=name, op=self._op,
+                                prescale_factor=self._prescale,
+                                postscale_factor=self._postscale)
+            self._handles[p] = (h, ctx)
+        for p in self._passes:
+            self._passes[p] = 0
         for p, (h, ctx) in list(self._handles.items()):
             reduced = synchronize(h)
             p.grad = self._compression.decompress(
@@ -343,6 +454,12 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0):
+    if hasattr(optimizer, "_hvd_base"):
+        # Re-wrapping would make the grafted step() re-enter itself through
+        # the newest swapped class (infinite recursion) and register every
+        # hook twice.
+        raise ValueError(
+            "optimizer is already wrapped by DistributedOptimizer")
     base = optimizer.__class__
     body = {k: v for k, v in _DistributedMixin.__dict__.items()
             if not k.startswith("__")}

@@ -1,89 +1,242 @@
-"""Autotuner for eager-runtime parameters.
+"""Autotuner for eager-runtime parameters — synchronized Bayesian search.
 
 Reference: /root/reference/horovod/common/parameter_manager.{h,cc} +
-common/optim/bayesian_optimization.cc — Bayesian optimization (GP + expected
-improvement) over fusion-threshold and cycle-time, scored in bytes/sec, with
-the winning parameters broadcast from the coordinator
-(Controller::SynchronizeParameters, controller.cc:39-53).
+common/optim/bayesian_optimization.cc + gaussian_process.cc — Bayesian
+optimization (Gaussian process + expected improvement) over
+fusion-threshold and cycle-time, scored in bytes/sec, with the winning
+parameters broadcast from the coordinator so every rank always runs the
+same knobs (Controller::SynchronizeParameters, controller.cc:39-53 —
+per-rank divergence would change fused-program signatures across ranks).
 
-On TPU the compiled path needs no tuning (XLA schedules), so the search
-space here is the *eager* runtime's fusion threshold and cycle time, plus
-the gradient-bucket size used by `horovod_tpu.opt` bucketing. Round-1
-implementation is a coordinate-descent hill climber over a log-scaled grid
-(the reference's categorical/continuous split, parameter_manager.h:186);
-scores are smoothed bytes/sec from `BackgroundRuntime` counters. A GP-EI
-upgrade can drop in behind the same `Autotuner.sample()` API.
+On TPU the compiled path needs no tuning (XLA schedules); the search space
+is the *eager* runtime's fusion threshold and cycle time. Design:
+
+- Rank 0 owns the GP: it scores its own smoothed bytes/sec (symmetric in
+  data-parallel steady state), observes (params, score) pairs, and proposes
+  the next point by maximizing expected improvement over log-scaled bounds.
+- Every proposal is published to the rendezvous KV store (scope
+  ``autotune``, key ``latest``); other ranks poll it cheaply each sample
+  and apply any newer proposal. After ``max_samples`` the best observed
+  point is published as final and tuning stops everywhere.
+- Single-process (no controller): same GP, applied locally.
+
+The GP here is an original small implementation: RBF kernel, fixed noise,
+Cholesky solve, EI acquisition maximized over a quasi-random candidate set
+(the role of the reference's L-BFGS ascent on the acquisition).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import math
 import time
 from typing import Optional
 
+import numpy as np
+
 LOG = logging.getLogger("horovod_tpu")
 
-_FUSION_GRID = [1 << 20, 4 << 20, 16 << 20, 64 << 20, 128 << 20, 256 << 20]
-_CYCLE_GRID = [0.5, 1.0, 2.5, 5.0, 10.0, 25.0]
+# log2-space bounds: fusion 1 MiB .. 256 MiB, cycle 0.5 .. 25 ms
+_BOUNDS = np.array([[20.0, 28.0],
+                    [math.log2(0.5), math.log2(25.0)]])
+
+
+class _GP:
+    """Minimal RBF-kernel Gaussian process (reference gaussian_process.cc
+    role), inputs normalized to [0,1]^d."""
+
+    def __init__(self, length_scale: float = 0.25, noise: float = 1e-3):
+        self.ls = length_scale
+        self.noise = noise
+        self._X: Optional[np.ndarray] = None
+        self._alpha = None
+        self._L = None
+
+    def _k(self, A, B):
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def fit(self, X: np.ndarray, y: np.ndarray):
+        self._X = X
+        K = self._k(X, X) + self.noise * np.eye(len(X))
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, y))
+
+    def predict(self, Xs: np.ndarray):
+        Ks = self._k(Xs, self._X)
+        mu = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-9, None)
+        return mu, np.sqrt(var)
+
+
+def _expected_improvement(mu, sigma, best, xi: float = 0.01):
+    """EI acquisition (reference bayesian_optimization.cc:ExpectedImprovement
+    semantics, original formula implementation)."""
+    z = (mu - best - xi) / sigma
+    # standard normal pdf/cdf without scipy
+    pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesianOptimizer:
+    """Propose points in normalized [0,1]^d maximizing EI; first
+    ``n_random`` proposals are low-discrepancy random exploration."""
+
+    def __init__(self, dims: int = 2, n_random: int = 4, seed: int = 0):
+        self.dims = dims
+        self.n_random = n_random
+        self.rng = np.random.RandomState(seed)
+        self.X: list[np.ndarray] = []
+        self.y: list[float] = []
+
+    def observe(self, x: np.ndarray, score: float):
+        self.X.append(np.asarray(x, float))
+        self.y.append(float(score))
+
+    def suggest(self) -> np.ndarray:
+        if len(self.X) < self.n_random:
+            return self.rng.uniform(size=self.dims)
+        X = np.stack(self.X)
+        y = np.asarray(self.y)
+        scale = y.std() or 1.0
+        gp = _GP()
+        gp.fit(X, (y - y.mean()) / scale)
+        cand = self.rng.uniform(size=(256, self.dims))
+        mu, sigma = gp.predict(cand)
+        ei = _expected_improvement(mu, sigma, (y.max() - y.mean()) / scale)
+        return cand[int(np.argmax(ei))]
+
+    def best(self) -> Optional[np.ndarray]:
+        if not self.X:
+            return None
+        return self.X[int(np.argmax(self.y))]
+
+
+def _to_params(x01: np.ndarray) -> tuple[int, float]:
+    lo, hi = _BOUNDS[:, 0], _BOUNDS[:, 1]
+    logs = lo + np.clip(x01, 0, 1) * (hi - lo)
+    return int(2.0 ** logs[0]), float(2.0 ** logs[1])
+
+
+def _from_params(fusion: int, cycle: float) -> np.ndarray:
+    lo, hi = _BOUNDS[:, 0], _BOUNDS[:, 1]
+    logs = np.array([math.log2(max(fusion, 1)), math.log2(max(cycle, 1e-3))])
+    return np.clip((logs - lo) / (hi - lo), 0, 1)
 
 
 class Autotuner:
-    def __init__(self, runtime, log_path: str = "", warmup_samples: int = 3):
+    """Scores smoothed bytes/sec and drives the synchronized search.
+
+    ``sample()`` is called from the background cycle loop every N working
+    cycles on every rank; only rank 0 (or a controller-less single process)
+    updates the GP and proposes; other ranks poll + apply.
+    """
+
+    SCOPE = "autotune"
+    KEY = "latest"
+
+    def __init__(self, runtime, log_path: str = "", warmup_samples: int = 3,
+                 max_samples: int = 20):
         self.runtime = runtime
         self.log_path = log_path
         self.warmup = warmup_samples
+        self.max_samples = max_samples
         self._samples = 0
         self._last_bytes = 0
         self._last_time = time.monotonic()
-        self._best_score = 0.0
-        self._tuning_axis = 0  # 0=fusion, 1=cycle
-        self._fusion_i = _FUSION_GRID.index(min(_FUSION_GRID,
-                                                key=lambda v: abs(v - runtime.fusion_threshold)))
-        self._cycle_i = _CYCLE_GRID.index(min(_CYCLE_GRID,
-                                              key=lambda v: abs(v - runtime.cycle_time_ms)))
-        self._direction = 1
+        self._seq_applied = -1
         self.done = False
+        ctl = runtime.controller
+        self._client = ctl.client if ctl is not None else None
+        self._rank = ctl.rank if ctl is not None else 0
+        self._opt = BayesianOptimizer() if self._rank == 0 else None
         if log_path:
             with open(log_path, "w") as f:
                 f.write("sample,fusion_bytes,cycle_ms,score_bytes_per_sec\n")
 
-    def sample(self):
-        """Record one scoring sample and maybe move a knob. Call periodically
-        (e.g. once per training step or per N cycles)."""
-        if self.done:
-            return
+    # -- scoring ------------------------------------------------------------
+    def _score(self) -> Optional[float]:
         now = time.monotonic()
         dt = now - self._last_time
         if dt <= 0:
-            return
+            return None
         db = self.runtime.bytes_processed - self._last_bytes
-        score = db / dt
         self._last_bytes = self.runtime.bytes_processed
         self._last_time = now
-        self._samples += 1
+        return db / dt
+
+    def _log(self, score: float):
         if self.log_path:
             with open(self.log_path, "a") as f:
                 f.write(f"{self._samples},{self.runtime.fusion_threshold},"
                         f"{self.runtime.cycle_time_ms},{score:.1f}\n")
-        if self._samples <= self.warmup:
-            self._best_score = max(self._best_score, score)
+
+    # -- parameter broadcast (SynchronizeParameters, controller.cc:39-53) ---
+    def _publish(self, fusion: int, cycle: float, final: bool):
+        self._seq_applied += 1
+        payload = json.dumps({"seq": self._seq_applied, "fusion": fusion,
+                              "cycle": cycle, "final": final}).encode()
+        if self._client is not None:
+            try:
+                self._client.put(self.SCOPE, self.KEY, payload)
+            except Exception as e:
+                LOG.warning("autotune publish failed: %s", e)
+
+    def poll_params(self) -> bool:
+        """Non-root: apply the coordinator's latest proposal if newer.
+        Returns True when an update was applied. Public so tests and
+        framework loops can force a final sync."""
+        if self._client is None or self._rank == 0:
+            return False
+        try:
+            raw = self._client.get(self.SCOPE, self.KEY, timeout=0.05)
+        except Exception:
+            return False
+        msg = json.loads(raw)
+        if msg["seq"] <= self._seq_applied:
+            return False
+        self._seq_applied = msg["seq"]
+        self.runtime.fusion_threshold = int(msg["fusion"])
+        self.runtime.cycle_time_ms = float(msg["cycle"])
+        if msg.get("final"):
+            self.done = True
+        return True
+
+    # -- main entry ---------------------------------------------------------
+    def sample(self):
+        if self._rank != 0:
+            self.poll_params()
+            score = self._score()
+            if score is not None:
+                self._samples += 1
+                self._log(score)
             return
-        if score >= self._best_score * 1.02:
-            self._best_score = score  # keep moving in this direction
-        else:
-            # revert / switch axis (coordinate descent)
-            self._direction = -self._direction
-            self._tuning_axis = 1 - self._tuning_axis
-            if self._tuning_axis == 0 and self._direction == 1:
-                self.done = True
-                LOG.info("autotune converged: fusion=%d cycle=%.2fms",
-                         self.runtime.fusion_threshold, self.runtime.cycle_time_ms)
-                return
-        if self._tuning_axis == 0:
-            self._fusion_i = min(max(self._fusion_i + self._direction, 0),
-                                 len(_FUSION_GRID) - 1)
-            self.runtime.fusion_threshold = _FUSION_GRID[self._fusion_i]
-        else:
-            self._cycle_i = min(max(self._cycle_i + self._direction, 0),
-                                len(_CYCLE_GRID) - 1)
-            self.runtime.cycle_time_ms = _CYCLE_GRID[self._cycle_i]
+        if self.done:
+            return
+        score = self._score()
+        if score is None:
+            return
+        self._samples += 1
+        self._log(score)
+        if self._samples <= self.warmup:
+            return
+        x_now = _from_params(self.runtime.fusion_threshold,
+                             self.runtime.cycle_time_ms)
+        self._opt.observe(x_now, score)
+        if self._samples >= self.max_samples + self.warmup:
+            fusion, cycle = _to_params(self._opt.best())
+            self.runtime.fusion_threshold = fusion
+            self.runtime.cycle_time_ms = cycle
+            self._publish(fusion, cycle, final=True)
+            self.done = True
+            LOG.info("autotune converged: fusion=%d cycle=%.2fms",
+                     fusion, cycle)
+            return
+        fusion, cycle = _to_params(self._opt.suggest())
+        self.runtime.fusion_threshold = fusion
+        self.runtime.cycle_time_ms = cycle
+        self._publish(fusion, cycle, final=False)

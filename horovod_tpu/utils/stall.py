@@ -57,5 +57,7 @@ class StallInspector:
             dead = [n for n, t in self._pending.items()
                     if now - t > self.shutdown_time_s]
             if dead:
-                raise StalledTensorError(
+                err = StalledTensorError(
                     f"tensors stalled beyond shutdown time: {sorted(dead)}")
+                err.names = sorted(dead)
+                raise err

@@ -6,8 +6,10 @@ Chrome trace-event JSON with a per-tensor NEGOTIATING → TOP_LEVEL → ACTIVITY
 state machine, runtime start/stop (operations.cc:738-764), and optional
 cycle markers.
 
-Here: a daemon writer thread fed by ``queue.SimpleQueue`` (the Python-native
-SPSC analogue), same JSON schema, so the output opens in
+Here: a daemon writer thread fed by the native C++ SPSC ring
+(`horovod_tpu._native` hvd_tl_*, the direct analogue of the reference's
+boost::lockfree::spsc_queue) with a ``queue.SimpleQueue`` fallback when
+the native core isn't built; same JSON schema, so the output opens in
 ``chrome://tracing`` / Perfetto exactly like the reference's. Device-side
 timing on TPU comes from ``jax.profiler`` traces instead of CUDA events —
 `start_jax_profiler`/`stop_jax_profiler` bridge to XPlane dumps.
@@ -15,6 +17,7 @@ timing on TPU comes from ``jax.profiler`` traces instead of CUDA events —
 
 from __future__ import annotations
 
+import ctypes
 import json
 import os
 import queue
@@ -22,11 +25,40 @@ import threading
 import time
 from typing import Optional
 
+_RING_CAPACITY = 1 << 16  # events (reference: 1M; sized for host traces)
+_DRAIN_BUF = 1 << 20
+
+
+class _NativeRing:
+    """ctypes wrapper over the C++ SPSC ring (core.cc hvd_tl_*)."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._ring = lib.hvd_tl_create(_RING_CAPACITY)
+        self._buf = ctypes.create_string_buffer(_DRAIN_BUF)
+
+    def put(self, rec):
+        data = b"" if rec is None else json.dumps(rec).encode()
+        self._lib.hvd_tl_push(self._ring, data, len(data))
+
+    def drain_lines(self):
+        n = self._lib.hvd_tl_drain(self._ring, self._buf, _DRAIN_BUF)
+        if n <= 0:
+            return []
+        return self._buf.raw[:n].decode().splitlines()
+
+    def __del__(self):
+        try:
+            self._lib.hvd_tl_destroy(self._ring)
+        except Exception:
+            pass
+
 
 class Timeline:
     """Per-tensor lane trace writer (chrome trace-event format)."""
 
     def __init__(self, filename: str = "", mark_cycles: bool = False):
+        self._native = None
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._file = None
         self._thread: Optional[threading.Thread] = None
@@ -40,6 +72,17 @@ class Timeline:
 
     # -- lifecycle ----------------------------------------------------------
     def _open(self, filename: str):
+        # native ring load/build is deferred to here: most inits never
+        # enable the timeline, and lib() may invoke a g++ build
+        if self._native is None:
+            from .._native import lib as _native_lib
+
+            L = _native_lib()
+            if L is not None:
+                try:
+                    self._native = _NativeRing(L)
+                except Exception:
+                    self._native = None
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._stop.clear()
@@ -73,13 +116,19 @@ class Timeline:
     def _ts_us(self) -> float:
         return (time.perf_counter() - self._start_ts) * 1e6
 
+    def _put(self, rec):
+        if self._native is not None:
+            self._native.put(rec)
+        else:
+            self._q.put(rec)
+
     def _tid(self, name: str) -> int:
         with self._lock:
             if name not in self._tids:
                 self._tids[name] = len(self._tids) + 1
-                self._q.put({"name": "process_name", "ph": "M", "pid": 0,
-                             "tid": self._tids[name],
-                             "args": {"name": name}})
+                self._put({"name": "process_name", "ph": "M", "pid": 0,
+                           "tid": self._tids[name],
+                           "args": {"name": name}})
             return self._tids[name]
 
     def _emit(self, name: str, ph: str, event: str, args=None):
@@ -90,7 +139,7 @@ class Timeline:
             rec["name"] = event
         if args:
             rec["args"] = args
-        self._q.put(rec)
+        self._put(rec)
 
     def negotiate_start(self, name: str, op_name: str):
         self._emit(name, "B", "NEGOTIATE_" + op_name)
@@ -106,11 +155,24 @@ class Timeline:
 
     def mark_cycle_start(self):
         if self.enabled and self.mark_cycles:
-            self._q.put({"ph": "i", "ts": self._ts_us(), "pid": 0, "tid": 0,
-                         "name": "CYCLE_START", "s": "g"})
+            self._put({"ph": "i", "ts": self._ts_us(), "pid": 0, "tid": 0,
+                       "name": "CYCLE_START", "s": "g"})
 
     # -- writer thread ------------------------------------------------------
     def _writer(self):
+        if self._native is not None:
+            while True:
+                lines = self._native.drain_lines()
+                for ln in lines:
+                    if ln and self._file:
+                        self._file.write(ln + ",\n")
+                if lines and self._file:
+                    self._file.flush()
+                if self._stop.is_set() and not lines:
+                    return
+                if not lines:
+                    time.sleep(0.02)
+            return
         while True:
             try:
                 rec = self._q.get(timeout=0.5)

@@ -122,39 +122,56 @@ def test_broadcast_callback_runs_once():
 
 # --- autotuner / stall ------------------------------------------------------
 
-def test_autotuner_moves_knobs():
+class _FakeRuntime:
+    def __init__(self):
+        self.fusion_threshold = 64 << 20
+        self.cycle_time_ms = 1.0
+        self.bytes_processed = 0
+        self.controller = None
+
+
+def test_autotuner_explores_and_converges():
     from horovod_tpu.utils.autotune import Autotuner
 
-    class FakeRuntime:
-        fusion_threshold = 64 << 20
-        cycle_time_ms = 1.0
-        bytes_processed = 0
-
-    rt = FakeRuntime()
-    at = Autotuner(rt, warmup_samples=1)
-    for i in range(6):
-        rt.bytes_processed += 1000 * (i + 1)
-        time.sleep(0.01)
+    rt = _FakeRuntime()
+    at = Autotuner(rt, warmup_samples=1, max_samples=5)
+    moved = False
+    for i in range(10):
+        rt.bytes_processed += 100_000 * (i + 1)
+        time.sleep(0.005)
         at.sample()
-    # it explored at least one knob move without crashing
-    assert (rt.fusion_threshold, rt.cycle_time_ms) != (64 << 20, 1.0) or at.done
+        if (rt.fusion_threshold, rt.cycle_time_ms) != (64 << 20, 1.0):
+            moved = True
+    assert moved  # Bayesian explorer proposed at least one new point
+    assert at.done  # and converged to the best observed after max_samples
 
 
 def test_autotune_log_written(tmp_path):
     from horovod_tpu.utils.autotune import Autotuner
 
-    class FakeRuntime:
-        fusion_threshold = 64 << 20
-        cycle_time_ms = 1.0
-        bytes_processed = 0
-
     log = tmp_path / "autotune.csv"
-    at = Autotuner(FakeRuntime(), log_path=str(log), warmup_samples=1)
+    at = Autotuner(_FakeRuntime(), log_path=str(log), warmup_samples=1)
     at.runtime.bytes_processed = 5000
     time.sleep(0.01)
     at.sample()
     text = log.read_text().splitlines()
     assert text[0].startswith("sample,") and len(text) >= 2
+
+
+def test_gp_expected_improvement_prefers_better_region():
+    """The GP-EI core (reference bayesian_optimization.cc role): after
+    observing a clear optimum, suggestions concentrate near it."""
+    import numpy as np
+
+    from horovod_tpu.utils.autotune import BayesianOptimizer
+
+    opt = BayesianOptimizer(dims=1, n_random=0, seed=1)
+    # score peaks at x=0.8
+    for x in (0.0, 0.2, 0.4, 0.6, 0.8, 1.0):
+        opt.observe(np.array([x]), -((x - 0.8) ** 2))
+    xs = [float(opt.suggest()[0]) for _ in range(5)]
+    assert min(abs(x - 0.8) for x in xs) < 0.15, xs
+    assert float(opt.best()[0]) == 0.8
 
 
 def test_stall_inspector_warns_and_shuts_down():

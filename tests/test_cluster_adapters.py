@@ -1,0 +1,100 @@
+"""Cluster integration adapters (reference horovod/ray + horovod/spark +
+horovod/mxnet): topology computation and the local engine are tested
+hermetically (the reference tests ray against a local mini-cluster; this
+image has no ray/spark/mxnet wheels, so backend entry points assert their
+gating errors instead)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ray.runner import Coordinator, LocalProcessEngine, RayExecutor
+from horovod_tpu.spark.common.store import FilesystemStore, Store
+
+
+def test_coordinator_topology():
+    """Rank/local/cross env computation (reference ray/runner.py:176)."""
+    c = Coordinator()
+    for rank, host in enumerate(["a", "a", "b", "b", "b"]):
+        c.register(host, rank)
+    assert c.world_size == 5
+    assert c.hoststring == "a:2,b:3"
+    envs = c.rank_envs()
+    assert envs[0]["HOROVOD_LOCAL_RANK"] == "0"
+    assert envs[1]["HOROVOD_LOCAL_RANK"] == "1"
+    assert envs[1]["HOROVOD_LOCAL_SIZE"] == "2"
+    assert envs[2]["HOROVOD_CROSS_RANK"] == "1"
+    assert envs[4]["HOROVOD_LOCAL_RANK"] == "2"
+    assert all(e["HOROVOD_SIZE"] == "5" for e in envs.values())
+    assert all(e["HOROVOD_CROSS_SIZE"] == "2" for e in envs.values())
+
+
+def _worker_fn(tag):
+    return (tag, os.environ.get("HOROVOD_RANK"),
+            os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT") is not None)
+
+
+def test_ray_executor_local_engine_runs():
+    """RayExecutor over the hermetic subprocess engine: env injection and
+    rank-ordered results (reference RayExecutor.run contract)."""
+    ex = RayExecutor(num_workers=2, engine="local")
+    ex.start()
+    try:
+        results = ex.run(_worker_fn, args=("x",))
+        assert [r[0] for r in results] == ["x", "x"]
+        assert sorted(r[1] for r in results) == ["0", "1"]
+        assert all(r[2] for r in results)  # rendezvous env present
+    finally:
+        ex.shutdown()
+
+
+def test_ray_engine_gated_without_ray():
+    with pytest.raises(ImportError, match="ray"):
+        RayExecutor(num_workers=2, engine="ray")
+
+
+def test_spark_run_gated_without_pyspark():
+    import horovod_tpu.spark as hvd_spark
+
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(lambda: None, num_proc=2)
+
+
+def test_filesystem_store_layout_and_io(tmp_path):
+    """Store path layout + bytes IO (reference spark/common/store.py:157)."""
+    store = Store.create(str(tmp_path / "st"))
+    assert isinstance(store, FilesystemStore)
+    ck = store.get_checkpoint_path("run7")
+    assert "runs" in ck and "run7" in ck
+    assert store.get_train_data_path(3).endswith("intermediate_train_data.3")
+    store.write_bytes(ck, b"weights")
+    assert store.exists(ck)
+    assert store.read_bytes(ck) == b"weights"
+    assert not store.exists(store.get_logs_path("run7"))
+
+
+def test_keras_estimator_checkpoint_roundtrip(tmp_path):
+    """Estimator checkpoints ride the Store (reference spark/keras
+    estimator save/load path) — no Spark needed for the artifact layer."""
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    model = keras.Sequential([keras.layers.Dense(2, input_shape=(3,))])
+    store = FilesystemStore(str(tmp_path / "st"))
+    est = KerasEstimator(model=model, store=store, run_id="r1")
+    est.save_checkpoint()
+    loaded = est.load_checkpoint()
+    np.testing.assert_allclose(loaded.layers[0].get_weights()[0],
+                               model.layers[0].get_weights()[0])
+
+
+def test_mxnet_module_gates_cleanly():
+    import horovod_tpu.mxnet as hvd_mx
+
+    assert hvd_mx.MXNET_AVAILABLE is False
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.allreduce(np.ones(3))
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.DistributedOptimizer(object())

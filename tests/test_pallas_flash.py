@@ -1,0 +1,87 @@
+"""Pallas flash-attention kernel numerics vs the plain-XLA oracle
+(SURVEY.md §5.7 pallas splash-attention; runs in interpret mode on the CPU
+test mesh, compiled on a real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.pallas.flash_attention import (
+    _lax_stats,
+    _reference_attention,
+    attention_stats,
+    flash_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.RandomState(0)
+    B, s, d = 2, 256, 64
+    mk = lambda: jnp.asarray(rng.randn(B, s, d), jnp.float32)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_reference(qkv, causal):
+    q, k, v = qkv
+    o = flash_attention(q, k, v, causal, 128, 128)
+    ref = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=1e-4)
+
+
+def test_flash_gradients_match_reference(qkv):
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, True, 128, 128) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference_attention(q, k, v, True) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+
+def test_attention_stats_contract(qkv):
+    """(o, m, l) stats: o normalized, exp-renormalization reconstructs the
+    unnormalized accumulator (the ring-combination contract)."""
+    q, k, v = qkv
+    o, m, l = attention_stats(q, k, v, False, 128, 128)
+    o2, m2, l2 = _lax_stats(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o2), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(m2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l2), rtol=1e-5)
+
+
+def test_attention_stats_differentiable(qkv):
+    """Cotangents flow through o, m and l (ring combine uses all three)."""
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        o, m, l = attention_stats(q, k, v, True, 128, 128)
+        return (o ** 2).sum() + (m * 0.1).sum() + (l * 0.01).sum()
+
+    def loss_ref(q, k, v):
+        o, m, l = _lax_stats(q, k, v, True)
+        return (o ** 2).sum() + (m * 0.1).sum() + (l * 0.01).sum()
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+
+def test_flash_bf16():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 128, 64), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(1, 128, 64), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, 128, 64), jnp.bfloat16)
+    o = flash_attention(q, k, v, True, 128, 128)
+    ref = _reference_attention(q, k, v, True)
+    assert o.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)

@@ -1,0 +1,181 @@
+"""horovod_tpu.tensorflow API (reference test/parallel/test_tensorflow.py
+patterns): collective numerics, IndexedSlices sparse path, tape gradients,
+optimizer wrap, broadcast_variables — single-process semantics plus a real
+2-process tape-allreduce launch."""
+
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+from horovod_tpu.runner.launch import run_commandline  # noqa: E402
+
+
+def setup_module():
+    hvd.init()
+
+
+def test_allreduce_dtypes_roundtrip():
+    for dtype in (tf.float32, tf.float64, tf.int32):
+        t = tf.cast(tf.range(8), dtype)
+        out = hvd.allreduce(t, op=hvd.Sum, name=f"tf.rt.{dtype.name}")
+        assert out.dtype == dtype
+        np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_allreduce_average_and_scales():
+    t = tf.ones((4,)) * 8.0
+    out = hvd.allreduce(t, average=True, name="tf.avg",
+                        prescale_factor=0.5, postscale_factor=2.0)
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_allreduce_fp16_compression():
+    t = tf.random.normal((16,), seed=0)
+    out = hvd.allreduce(t, average=True, name="tf.fp16",
+                        compression=hvd.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), t.numpy(), atol=1e-2)
+
+
+def test_indexed_slices_allgather_path():
+    """Reference tensorflow/__init__.py:92-108: sparse gradients become an
+    allgather of values+indices; AVERAGE divides values by size."""
+    s = tf.IndexedSlices(values=tf.constant([[2.0, 4.0]]),
+                         indices=tf.constant([1]),
+                         dense_shape=tf.constant([3, 2]))
+    out = hvd.allreduce(s, average=True, name="tf.idx")
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), [[2.0, 4.0]])
+    np.testing.assert_array_equal(out.indices.numpy(), [1])
+
+
+def test_grouped_allreduce():
+    ts = [tf.fill((4,), float(i)) for i in range(3)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Sum)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), np.full(4, float(i)))
+
+
+def test_allgather_broadcast_alltoall_reducescatter():
+    t = tf.reshape(tf.range(6, dtype=tf.float32), (3, 2))
+    np.testing.assert_allclose(hvd.allgather(t, name="tf.ag").numpy(),
+                               t.numpy())
+    np.testing.assert_allclose(hvd.broadcast(t, 0, name="tf.bc").numpy(),
+                               t.numpy())
+    out, splits = hvd.alltoall(tf.range(4.0), name="tf.a2a")
+    np.testing.assert_allclose(out.numpy(), np.arange(4.0))
+    rs = hvd.reducescatter(tf.range(8.0), op=hvd.Sum, name="tf.rs")
+    np.testing.assert_allclose(rs.numpy(), np.arange(8.0))
+
+
+def test_broadcast_variables_and_objects():
+    v = tf.Variable([1.0, 2.0, 3.0])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0, 3.0])
+    assert hvd.broadcast_object({"a": 1}) == {"a": 1}
+    assert hvd.allgather_object(7) == [7]
+
+
+def test_distributed_gradient_tape_numerics():
+    x = tf.Variable([3.0, 4.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(x * x)
+    tape = hvd.DistributedGradientTape(tape)
+    (g,) = tape.gradient(y, [x])
+    np.testing.assert_allclose(g.numpy(), [6.0, 8.0])
+
+
+def test_distributed_gradient_tape_predivide():
+    """gradient_predivide_factor splits averaging into pre/post scaling;
+    net effect at size=1 is identity."""
+    x = tf.Variable([2.0])
+    with tf.GradientTape() as tape:
+        y = x * x
+    tape = hvd.DistributedGradientTape(tape, gradient_predivide_factor=2.0)
+    (g,) = tape.gradient(y, [x])
+    np.testing.assert_allclose(g.numpy(), [4.0])
+
+
+def test_keras_distributed_optimizer_trains():
+    import keras
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.layers.Dense(8, activation="relu"),
+                              keras.layers.Dense(1)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse")
+    X = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = (X.sum(1, keepdims=True) > 0).astype(np.float32)
+    h = model.fit(X, y, epochs=5, batch_size=16, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_keras_rejects_double_wrap():
+    import keras
+
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05))
+    with pytest.raises(ValueError, match="already"):
+        hvd.DistributedOptimizer(opt)
+
+
+def test_sync_batch_norm_single_process():
+    layer = hvd.SyncBatchNormalization(axis=-1)
+    x = tf.random.normal((8, 4), seed=1)
+    out = layer(x, training=True)
+    m = out.numpy().mean(axis=0)
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-2)
+
+
+def test_tensorflow_keras_state_commit_restore():
+    import keras
+
+    model = keras.Sequential([keras.layers.Dense(2)])
+    model.build((None, 3))
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    state = TensorFlowKerasState(model, epoch=0)
+    state.commit()
+    before = model.variables[0].numpy().copy()
+    model.variables[0].assign(before + 1.0)
+    state.restore()
+    np.testing.assert_allclose(model.variables[0].numpy(), before)
+
+
+TAPE_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()  # eager collectives are per-process
+
+    # rank-dependent gradients -> tape must return the global average
+    x = tf.Variable([float(r + 1)])
+    with tf.GradientTape() as tape:
+        y = x * x          # dy/dx = 2(r+1): rank0 -> 2, rank1 -> 4
+    tape = hvd.DistributedGradientTape(tape)
+    (g,) = tape.gradient(y, [x])
+    assert np.allclose(g.numpy(), [3.0]), g.numpy()  # (2+4)/2
+
+    # broadcast_variables aligns weights to rank 0's
+    v = tf.Variable([10.0 + r])
+    hvd.broadcast_variables([v], root_rank=0)
+    assert np.allclose(v.numpy(), [10.0]), v.numpy()
+    print("tf tape OK", r)
+""")
+
+
+def test_tape_allreduce_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(TAPE_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0

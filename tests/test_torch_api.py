@@ -134,3 +134,76 @@ def test_torch_state_commit_restore():
     for k in before:
         assert torch.equal(before[k], after[k])
     assert state.epoch == 1
+
+
+def test_grouped_allreduce_and_inplace():
+    """Reference torch/mpi_ops.py:345,:444 grouped semantics (single-process:
+    identity), including the in-place variant mutating its inputs."""
+    ts = [torch.full((4,), float(i)) for i in range(3)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Sum, name="t.torch.grp")
+    for i, o in enumerate(outs):
+        assert torch.allclose(o, torch.full((4,), float(i)))
+    ts2 = [torch.full((2,), float(i)) for i in range(3)]
+    outs2 = hvd.grouped_allreduce_(ts2, op=hvd.Sum, name="t.torch.grp_")
+    for t, o in zip(ts2, outs2):
+        assert o is t
+
+
+def test_reducescatter():
+    """Reference reducescatter: sum-reduce then scatter dim-0 chunks; with
+    one process the full reduced tensor comes back."""
+    t = torch.arange(8, dtype=torch.float32)
+    out = hvd.reducescatter(t, name="t.torch.rs", op=hvd.Sum)
+    assert torch.equal(out, t)
+
+
+def test_process_set_kwarg_accepted():
+    """process_set= threads through to the core (None = global set)."""
+    t = torch.ones(4)
+    out = hvd.allreduce(t, op=hvd.Sum, name="t.torch.ps", process_set=None)
+    assert torch.equal(out, t)
+
+
+def test_distributed_optimizer_rejects_double_wrap():
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    with pytest.raises(ValueError, match="already wrapped"):
+        hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+
+
+def test_distributed_optimizer_rejects_duplicate_names():
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    params = list(model.named_parameters())
+    dup = [("same", params[0][1]), ("same", params[1][1])]
+    with pytest.raises(ValueError, match="duplicate"):
+        hvd.DistributedOptimizer(opt, named_parameters=dup)
+
+
+def test_synchronize_covers_unfired_hooks():
+    """Reference optimizer.py synchronize(): a param whose hook never fired
+    (dynamically unused) still gets reduced (as zeros) so all ranks submit
+    identical collective sets."""
+    torch.manual_seed(0)
+    lin1 = torch.nn.Linear(4, 4)
+    lin2 = torch.nn.Linear(4, 4)  # never used in forward
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a, self.b = lin1, lin2
+
+        def forward(self, x):
+            return self.a(x)
+
+    model = Net()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    model(torch.randn(2, 4)).sum().backward()
+    opt.step()  # must not hang or raise: b's params reduced as zeros
+    assert lin2.weight.grad is not None
+    assert torch.allclose(lin2.weight.grad, torch.zeros_like(lin2.weight))
