@@ -119,13 +119,29 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
             values = values / tf.cast(n, values.dtype)
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
-    t, ctx = compression.compress(tensor)
-    h = _core.allreduce_async(_to_np(t), average, name, op=op,
-                              prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor,
-                              process_set=process_set)
-    out = _from_np(_core.synchronize(h), t.dtype)
-    return compression.decompress(out, ctx)
+    @tf.custom_gradient
+    def _op(t_in):
+        t, ctx = compression.compress(t_in)
+        h = _core.allreduce_async(_to_np(t), average, name, op=op,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor,
+                                  process_set=process_set)
+        out = _from_np(_core.synchronize(h), t.dtype)
+        out = compression.decompress(out, ctx)
+
+        def grad(dy):
+            # gradient of an allreduce is an allreduce of the gradient with
+            # the same op (reference mpi_ops.py:124-171 gradient
+            # registrations: sum→sum, average→average)
+            return allreduce(dy, average=average, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             name=f"{name}.grad" if name else None,
+                             process_set=process_set)
+
+        return out, grad
+
+    return _op(tensor)
 
 
 import itertools as _itertools

@@ -45,6 +45,7 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
 from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
 
 
 class Compression:

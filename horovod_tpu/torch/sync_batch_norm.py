@@ -1,0 +1,146 @@
+"""Cross-worker synchronized BatchNorm for torch models.
+
+Reference: /root/reference/horovod/torch/sync_batch_norm.py — batch
+statistics averaged over all workers each training step, with a real
+autograd Function whose backward carries the gradient terms through the
+global mean/invstd (:141+). Design here: local mean / mean-of-squares are
+averaged with one eager allreduce (equal per-worker batch is the
+data-parallel contract, making the average of moments exact), and the
+backward allreduce-averages the per-worker gradient sums the same way.
+
+Collective names come from a deterministic per-construction counter, not
+object identity: every rank must submit identical names for negotiation
+to match (same-model-construction-order contract, like the reference's
+call-ordered naming).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu as _core
+
+_bn_counter = itertools.count()
+
+
+def _allreduce_avg_pair(a: torch.Tensor, b: torch.Tensor, name: str):
+    stacked = torch.stack([a, b]).detach().cpu().numpy()
+    out = np.asarray(_core.synchronize(_core.allreduce_async(
+        stacked, average=True, name=name)))
+    return (torch.from_numpy(np.ascontiguousarray(out[0])).to(a.dtype),
+            torch.from_numpy(np.ascontiguousarray(out[1])).to(b.dtype))
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, eps, name):
+        dims = [0] + list(range(2, input.dim()))
+        mean = input.mean(dim=dims)
+        meansq = (input * input).mean(dim=dims)
+        if _core.cross_size() > 1:
+            mean, meansq = _allreduce_avg_pair(mean, meansq,
+                                               f"{name}.fwd_moments")
+        var = (meansq - mean * mean).clamp_(min=0.0)
+        invstd = torch.rsqrt(var + eps)
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(input, mean, invstd, weight)
+        ctx.bn_name = name
+        ctx.dims = dims
+        # expose stats for the module's running-average update
+        ctx.mark_non_differentiable = ()
+        return out, mean.detach(), var.detach()
+
+    @staticmethod
+    def backward(ctx, dy, _dmean, _dvar):
+        input, mean, invstd, weight = ctx.saved_tensors
+        dims = ctx.dims
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        n_local = input.numel() // input.shape[1]
+        # per-feature gradient sums over the *global* batch: average the
+        # per-worker means (equal local counts), reference
+        # sync_batch_norm.py backward's allreduce of sum_dy / sum_dy_xmu
+        mean_dy = dy.mean(dim=dims)
+        mean_dy_xhat = (dy * xhat).mean(dim=dims)
+        if _core.cross_size() > 1:
+            mean_dy, mean_dy_xhat = _allreduce_avg_pair(
+                mean_dy, mean_dy_xhat, f"{ctx.bn_name}.bwd_moments")
+        gx = invstd.view(shape) * (
+            dy - mean_dy.view(shape) - xhat * mean_dy_xhat.view(shape))
+        if weight is not None:
+            gx = gx * weight.view(shape)
+            # weight/bias grads stay local: the DistributedOptimizer's
+            # gradient allreduce handles their reduction (reference keeps
+            # the same split)
+            gw = (dy * xhat).sum(dim=dims)
+            gb = dy.sum(dim=dims)
+        else:
+            gw = gb = None
+        return gx, gw, gb, None, None
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Drop-in for torch.nn.BatchNorm1d/2d/3d in data-parallel training."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._hvd_name = f"torch.sync_bn.{next(_bn_counter)}"
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {input.dim()}D")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if not self.training:
+            return F.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, False, 0.0, self.eps)
+
+        # torch._BatchNorm semantics: momentum=None means a cumulative
+        # moving average with factor 1/num_batches_tracked
+        if self.track_running_stats and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+            eaf = (1.0 / float(self.num_batches_tracked)
+                   if self.momentum is None else self.momentum)
+        else:
+            eaf = 0.0 if self.momentum is None else self.momentum
+
+        out, mean, var = _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.eps, self._hvd_name)
+        if self.track_running_stats:
+            n_global = (input.numel() // input.shape[1]) * max(
+                _core.cross_size(), 1)
+            unbiased = var * (n_global / max(n_global - 1, 1))
+            with torch.no_grad():
+                self.running_mean.mul_(1 - eaf).add_(mean * eaf)
+                self.running_var.mul_(1 - eaf).add_(unbiased * eaf)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, module):
+        """Recursively replace BatchNorm layers (torch DDP
+        convert_sync_batchnorm convention)."""
+        out = module
+        if isinstance(module, torch.nn.modules.batchnorm._BatchNorm) and \
+                not isinstance(module, cls):
+            out = cls(module.num_features, module.eps, module.momentum,
+                      module.affine, module.track_running_stats)
+            if module.affine:
+                with torch.no_grad():
+                    out.weight.copy_(module.weight)
+                    out.bias.copy_(module.bias)
+            out.running_mean = module.running_mean
+            out.running_var = module.running_var
+            out.num_batches_tracked = module.num_batches_tracked
+        for name, child in module.named_children():
+            out.add_module(name, cls.convert_sync_batchnorm(child))
+        return out

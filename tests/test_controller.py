@@ -337,3 +337,38 @@ def test_hierarchical_eager_collectives(tmp_path):
     script.write_text(HIER_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+SYNCBN_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    torch.manual_seed(99)  # same model on both ranks (construction order
+                           # gives both the same collective names)
+    bn = hvd.SyncBatchNorm(2)
+    # rank-dependent inputs: global batch = concat of both ranks' batches
+    x = torch.full((4, 2), float(r), requires_grad=True)
+    y = bn(x)
+    # global mean = 0.5 -> rank0 normalizes to -1, rank1 to +1
+    expect = -1.0 if r == 0 else 1.0
+    assert np.allclose(y.detach().numpy(), expect, atol=1e-4), y
+    y.sum().backward()  # backward's moment allreduce must also negotiate
+    assert x.grad is not None
+    print("syncbn OK", r)
+""")
+
+
+def test_sync_batch_norm_two_processes(tmp_path):
+    """Cross-rank moment averaging: each rank normalizes against the
+    *global* batch statistics (reference torch/sync_batch_norm.py)."""
+    script = tmp_path / "worker.py"
+    script.write_text(SYNCBN_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0

@@ -179,3 +179,14 @@ def test_tape_allreduce_two_processes(tmp_path):
     script.write_text(TAPE_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+def test_allreduce_is_differentiable():
+    """Gradient registration parity (reference mpi_ops.py:124): the
+    gradient of allreduce is an allreduce of the gradient."""
+    x = tf.Variable([2.0, 3.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum, name="tf.diff") * x)
+    (g,) = tape.gradient(y, [x])
+    # size=1: allreduce(x)=x, so y = sum(x^2), dy/dx = 2x
+    np.testing.assert_allclose(g.numpy(), [4.0, 6.0])

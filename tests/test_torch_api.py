@@ -207,3 +207,38 @@ def test_synchronize_covers_unfired_hooks():
     opt.step()  # must not hang or raise: b's params reduced as zeros
     assert lin2.weight.grad is not None
     assert torch.allclose(lin2.weight.grad, torch.zeros_like(lin2.weight))
+
+
+def test_sync_batch_norm_matches_torch_bn():
+    """SyncBatchNorm (reference torch/sync_batch_norm.py): single process
+    must match torch BatchNorm exactly — forward, input/weight gradients
+    (the backward carries the mean/invstd terms), unbiased running_var,
+    num_batches_tracked; convert_sync_batchnorm swaps layers."""
+    torch.manual_seed(0)
+    x1 = torch.randn(16, 4, requires_grad=True)
+    x2 = x1.detach().clone().requires_grad_(True)
+    bn = hvd.SyncBatchNorm(4)
+    ref = torch.nn.BatchNorm1d(4)
+    y1, y2 = bn(x1), ref(x2)
+    torch.testing.assert_close(y1, y2, atol=1e-5, rtol=1e-4)
+    (y1 * torch.arange(4.0)).sum().backward()
+    (y2 * torch.arange(4.0)).sum().backward()
+    torch.testing.assert_close(x1.grad, x2.grad, atol=1e-5, rtol=1e-4)
+    torch.testing.assert_close(bn.weight.grad, ref.weight.grad,
+                               atol=1e-5, rtol=1e-4)
+    torch.testing.assert_close(bn.running_var, ref.running_var,
+                               atol=1e-6, rtol=1e-5)
+    assert int(bn.num_batches_tracked) == 1
+
+    # momentum=None = cumulative moving average (torch semantics)
+    bn2 = hvd.SyncBatchNorm(4, momentum=None)
+    bn2(torch.randn(8, 4))
+    bn2(torch.randn(8, 4))
+    bn2.eval()
+    bn2(torch.randn(8, 4))
+    assert int(bn2.num_batches_tracked) == 2
+
+    model = torch.nn.Sequential(torch.nn.Linear(4, 4),
+                                torch.nn.BatchNorm1d(4))
+    conv = hvd.SyncBatchNorm.convert_sync_batchnorm(model)
+    assert isinstance(conv[1], hvd.SyncBatchNorm)
