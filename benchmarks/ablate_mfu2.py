@@ -1,0 +1,121 @@
+"""Peak-check ablations (run on the real chip): pure matmul/conv peak
+vs ResNet forward, with and without BatchNorm."""
+import functools, builtins
+print = functools.partial(builtins.print, flush=True)
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 197e12
+
+
+def timeit(f, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = f(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    # 1. pure bf16 matmul peak
+    for n in (4096, 8192):
+        a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+        b = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        dt = timeit(f, a, b)
+        fl = 2 * n ** 3
+        print(flush=True) or print(f"matmul {n:5d}: {dt*1e3:7.2f} ms  {fl/dt/1e12:6.1f} TF/s  "
+              f"mfu={fl/dt/PEAK:.3f}")
+
+    # 2. conv peak: representative resnet conv (56x56, 64ch, 3x3)
+    for (b, h, c, k) in ((256, 56, 64, 64), (256, 28, 128, 128),
+                         (256, 14, 256, 256)):
+        x = jnp.asarray(np.random.randn(b, h, h, c), jnp.bfloat16)
+        w = jnp.asarray(np.random.randn(3, 3, c, k), jnp.bfloat16)
+
+        @jax.jit
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        dt = timeit(conv, x, w)
+        fl = 2 * b * h * h * 3 * 3 * c * k
+        print(f"conv b{b} {h}x{h} {c}->{k}: {dt*1e3:7.2f} ms  "
+              f"{fl/dt/1e12:6.1f} TF/s  mfu={fl/dt/PEAK:.3f}")
+
+    # 3. resnet fwd without BN (norm = identity)
+    import flax.linen as nn
+
+    from horovod_tpu.models.resnet import ResNet
+
+    class NoNorm(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    batch = 256
+    images = jnp.asarray(np.random.RandomState(0).randn(batch, 224, 224, 3),
+                         jnp.bfloat16)
+    FWD = 4.09e9
+
+    import horovod_tpu.models.resnet as resnet_mod
+
+    model = ResNet(stage_sizes=[3, 4, 6, 3], num_classes=1000,
+                   dtype=jnp.bfloat16)
+    # monkeypatch: swap BatchNorm for identity to isolate its cost
+    orig_norm = nn.BatchNorm
+
+    class IdNorm(nn.Module):
+        use_running_average: bool = False
+        momentum: float = 0.9
+        epsilon: float = 1e-5
+        dtype: any = None
+        axis_name: str = None
+        scale_init: any = None
+        name: str = None
+
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    try:
+        nn.BatchNorm = IdNorm
+        resnet_mod.nn.BatchNorm = IdNorm
+        m2 = ResNet(stage_sizes=[3, 4, 6, 3], num_classes=1000,
+                    dtype=jnp.bfloat16)
+        v2 = m2.init(jax.random.PRNGKey(0), images[:2], train=True)
+
+        @jax.jit
+        def fwd2(v, x):
+            return m2.apply(v, x, train=True)
+
+        dt = timeit(fwd2, v2, images)
+        print(f"resnet fwd NO-BN:  {dt*1e3:7.2f} ms  "
+              f"{batch/dt:8.1f} img/s  mfu={batch*FWD/dt/PEAK:.3f}")
+    finally:
+        nn.BatchNorm = orig_norm
+        resnet_mod.nn.BatchNorm = orig_norm
+
+    # 4. baseline fwd again for comparison
+    v = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+
+    @jax.jit
+    def fwd(v, x):
+        out, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
+        return out
+
+    dt = timeit(fwd, v, images)
+    print(f"resnet fwd BN:     {dt*1e3:7.2f} ms  "
+          f"{batch/dt:8.1f} img/s  mfu={batch*FWD/dt/PEAK:.3f}")
+
+
+if __name__ == "__main__":
+    main()

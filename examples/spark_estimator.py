@@ -1,0 +1,46 @@
+"""Spark-style estimator training (reference examples/spark/pytorch/
+pytorch_spark_mnist.py usage shape: build an estimator around a model +
+store, fit a DataFrame, transform predictions).
+
+Runs hermetically on a pandas DataFrame (no Spark needed); with pyspark
+installed the same estimator accepts a Spark DataFrame and
+``horovod_tpu.spark.run`` launches one worker per executor.
+
+Run:  python examples/spark_estimator.py
+"""
+
+import tempfile
+
+import numpy as np
+import pandas as pd
+import torch
+
+from horovod_tpu.spark import FilesystemStore, TorchEstimator
+
+
+def main():
+    torch.manual_seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 8).astype(np.float32)
+    w = rng.randn(8, 1).astype(np.float32)
+    y = (x @ w + 0.01 * rng.randn(512, 1)).astype(np.float32)
+    df = pd.DataFrame({"features": list(x), "label": list(y[:, 0])})
+
+    store = FilesystemStore(tempfile.mkdtemp(prefix="hvd_spark_store_"))
+    est = TorchEstimator(
+        model=torch.nn.Sequential(torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                                  torch.nn.Linear(16, 1)),
+        optimizer=lambda p: torch.optim.Adam(p, lr=0.01),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["features"], label_cols=["label"],
+        validation=0.1, batch_size=64, epochs=20,
+        store=store, run_id="spark_example", verbose=0)
+    model = est.fit(df)
+    out = model.transform(df)
+    pred = np.asarray(list(out["prediction"]), np.float32)
+    print(f"train MSE: {float(np.mean((pred - y[:, 0]) ** 2)):.5f}")
+    print(f"checkpoint at: {est.checkpoint_path()}")
+
+
+if __name__ == "__main__":
+    main()

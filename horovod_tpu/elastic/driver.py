@@ -197,6 +197,31 @@ class ElasticDriver:
         self.rendezvous.stop()
 
 
+def make_base_env_fn(driver: ElasticDriver, extra: dict,
+                     hostname_override: Optional[str] = None):
+    """Per-slot env factory shared by the CLI elastic path and the Ray
+    elastic executor. One coordinator address per round: every slot of a
+    round must share it (jax.distributed world bootstrap), and each round
+    needs a fresh port — the previous incarnation's coordinator may still
+    be tearing down."""
+    from ..common import env as env_schema
+    from ..runner.launch import _free_port, slot_env
+
+    coord_by_epoch: dict[int, str] = {}
+
+    def base_env(slot: SlotInfo) -> dict:
+        ep = driver._epoch
+        if ep not in coord_by_epoch:
+            coord_by_epoch[ep] = f"127.0.0.1:{_free_port()}"
+        e = slot_env(slot, "127.0.0.1", driver.rendezvous.port,
+                     coord_by_epoch[ep], extra)
+        if hostname_override is not None:
+            e[env_schema.HOROVOD_HOSTNAME] = hostname_override
+        return e
+
+    return base_env
+
+
 def run_elastic(command: list[str], args) -> int:
     """CLI entry (reference launch.py:621 _run_elastic →
     gloo_run_elastic)."""
@@ -205,8 +230,7 @@ def run_elastic(command: list[str], args) -> int:
     import tempfile
     import uuid
 
-    from ..common import env as env_schema
-    from ..runner.launch import _free_port, _knob_env, build_ssh_command, slot_env
+    from ..runner.launch import _knob_env, build_ssh_command
 
     if not args.host_discovery_script:
         raise SystemExit("elastic mode requires --host-discovery-script")
@@ -224,17 +248,7 @@ def run_elastic(command: list[str], args) -> int:
         os.path.join(tempfile.gettempdir(),
                      f"hvd_elastic_{uuid.uuid4().hex[:8]}.pkl"))
 
-    # one coordinator address per round: every slot of a round must share it
-    # (jax.distributed world bootstrap), and each round needs a fresh port —
-    # the previous incarnation's coordinator may still be tearing down.
-    coord_by_epoch: dict[int, str] = {}
-
-    def base_env(slot: SlotInfo) -> dict:
-        ep = driver._epoch
-        if ep not in coord_by_epoch:
-            coord_by_epoch[ep] = f"127.0.0.1:{_free_port()}"
-        return slot_env(slot, "127.0.0.1", driver.rendezvous.port,
-                        coord_by_epoch[ep], extra)
+    base_env = make_base_env_fn(driver, extra)
 
     def create_worker(slot: SlotInfo, env: dict) -> WorkerHandle:
         if slot.hostname in (socket.gethostname(), "localhost", "127.0.0.1"):

@@ -2,13 +2,15 @@
 mpi_ops.py + __init__.py — DistributedOptimizer :40, gluon
 DistributedTrainer :102, broadcast_parameters :191).
 
-MXNet is not installed in this image; the module gates on import and
-raises a clear error from every entry point, while keeping the full API
-surface importable for introspection (``horovod_tpu.mxnet.MXNET_AVAILABLE``
-tells integrations at runtime). When an mxnet wheel is present the
-implementations below activate: NDArrays cross the boundary as numpy and
-collectives execute on the shared horovod_tpu eager runtime, exactly like
-the torch/tf shims.
+MXNet is not installed in this image, so the adapter is duck-typed: any
+array-like with ``asnumpy()`` (a real NDArray) or convertible via
+``np.asarray`` crosses the boundary as numpy, collectives execute on the
+shared horovod_tpu eager runtime (exactly like the torch/tf shims), and
+results are wrapped back as ``mx.nd.array`` only when mxnet is importable
+(``MXNET_AVAILABLE``). This keeps the full API surface — including the
+optimizer/trainer gradient-reduction logic — numerically testable without
+an mxnet wheel; gluon's ``DistributedTrainer`` alone needs the real
+package.
 """
 
 from __future__ import annotations
@@ -53,44 +55,47 @@ def _to_np(t) -> np.ndarray:
     return t.asnumpy() if hasattr(t, "asnumpy") else np.asarray(t)
 
 
+def _wrap(out, like):
+    """Return results in the caller's currency: mx NDArray when mxnet is
+    importable and the input was one, numpy otherwise."""
+    arr = np.asarray(out)
+    if MXNET_AVAILABLE and hasattr(like, "asnumpy"):
+        return mx.nd.array(arr, ctx=like.context, dtype=like.dtype)
+    return arr
+
+
 def allreduce(tensor, average: bool = True, name: Optional[str] = None,
               priority: int = 0, prescale_factor: float = 1.0,
               postscale_factor: float = 1.0):
-    _require_mxnet()
+    """Reference mxnet/mpi_ops.py allreduce (priority is accepted for API
+    parity; the eager runtime orders by submission)."""
     out = _core.synchronize(_core.allreduce_async(
         _to_np(tensor), average, name, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor))
-    return mx.nd.array(np.asarray(out), ctx=tensor.context,
-                       dtype=tensor.dtype)
+    return _wrap(out, tensor)
 
 
 def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
                priority: int = 0):
-    _require_mxnet()
     out = allreduce(tensor, average, name, priority)
     tensor[:] = out
     return tensor
 
 
 def allgather(tensor, name: Optional[str] = None, priority: int = 0):
-    _require_mxnet()
     out = _core.synchronize(_core.allgather_async(_to_np(tensor), name))
-    return mx.nd.array(np.asarray(out), ctx=tensor.context,
-                       dtype=tensor.dtype)
+    return _wrap(out, tensor)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               priority: int = 0):
-    _require_mxnet()
     out = _core.synchronize(_core.broadcast_async(_to_np(tensor), root_rank,
                                                   name))
-    return mx.nd.array(np.asarray(out), ctx=tensor.context,
-                       dtype=tensor.dtype)
+    return _wrap(out, tensor)
 
 
 def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
                priority: int = 0):
-    _require_mxnet()
     out = broadcast(tensor, root_rank, name, priority)
     tensor[:] = out
     return tensor
@@ -98,23 +103,28 @@ def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              priority: int = 0):
-    _require_mxnet()
     out, recv = _core.synchronize(_core.alltoall_async(
         _to_np(tensor), None if splits is None else _to_np(splits), name))
-    return (mx.nd.array(np.asarray(out), ctx=tensor.context),
-            mx.nd.array(np.asarray(recv)))
+    recv_arr = np.asarray(recv)
+    if MXNET_AVAILABLE and hasattr(tensor, "asnumpy"):
+        # received_splits keep their own (integer) dtype — casting them
+        # to the data tensor's float dtype would break split arithmetic
+        recv_out = mx.nd.array(recv_arr, ctx=tensor.context,
+                               dtype=recv_arr.dtype)
+    else:
+        recv_out = recv_arr
+    return _wrap(out, tensor), recv_out
 
 
 def broadcast_parameters(params, root_rank: int = 0):
-    """Gluon ParameterDict or plain dict of NDArrays (reference
+    """Gluon ParameterDict or plain dict of arrays (reference
     mxnet/__init__.py:191)."""
-    _require_mxnet()
-    if hasattr(params, "items"):
-        items = sorted(params.items())
-    else:
+    if not hasattr(params, "items"):
         raise ValueError("invalid params type")
-    for name, p in items:
-        arr = p.data() if hasattr(p, "data") else p
+    for name, p in sorted(params.items()):
+        # gluon Parameter exposes .data() as a method; a bare ndarray's
+        # .data attribute is its (non-callable) memoryview
+        arr = p.data() if callable(getattr(p, "data", None)) else p
         out = _core.synchronize(_core.broadcast_async(
             _to_np(arr), root_rank, f"mx.bcast.{name}"))
         arr[:] = np.asarray(out)
@@ -126,7 +136,6 @@ class DistributedOptimizer:
     (reference mxnet/__init__.py:40)."""
 
     def __init__(self, optimizer):
-        _require_mxnet()
         self._optimizer = optimizer
 
     def __getattr__(self, item):

@@ -14,6 +14,10 @@ stays hermetically testable without a Ray cluster (the reference tests
 against ``ray.init(local)``; this image has no ray wheel at all).
 """
 
+from .elastic import (  # noqa: F401
+    ElasticRayExecutor,
+    RayHostDiscovery,
+)
 from .runner import (  # noqa: F401
     Coordinator,
     LocalProcessEngine,

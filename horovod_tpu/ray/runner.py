@@ -223,10 +223,24 @@ class RayExecutor:
         import socket
 
         addr = socket.gethostbyname(socket.gethostname())
-        for e in envs.values():
+        # one jax.distributed coordinator for the whole job, so workers'
+        # hvd.init() bootstraps a real multi-process world (same env the
+        # SSH launcher injects — runner/launch.py slot_env). Process 0 is
+        # the one that BINDS the coordinator socket, so the address must
+        # be rank 0's host — not necessarily the driver (RayEngine can
+        # place worker 0 on another node). Limitation: the port is probed
+        # free on the driver; on a remote rank-0 host a collision is
+        # possible (rare: ephemeral-range port, checked moments before).
+        from ..runner.launch import _free_port
+
+        coord = f"{hostnames[0]}:{_free_port()}"
+        for rank, e in envs.items():
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR] = addr
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT] = str(port)
             e[env_schema.HOROVOD_CONTROLLER] = "kv"
+            e[env_schema.HOROVOD_TPU_COORDINATOR] = coord
+            e[env_schema.HOROVOD_TPU_NUM_PROCESSES] = str(self.num_workers)
+            e[env_schema.HOROVOD_TPU_PROCESS_ID] = str(rank)
         self._engine.start(self.num_workers, envs)
         self._started = True
 

@@ -19,6 +19,21 @@ from typing import Callable, Optional
 from .common.store import FilesystemStore, HDFSStore, LocalStore, Store  # noqa: F401
 
 
+def __getattr__(name):
+    # estimators import torch/keras lazily; expose them at package level
+    # (reference: horovod.spark.keras.KerasEstimator,
+    # horovod.spark.torch.TorchEstimator)
+    if name in ("TorchEstimator", "TorchModel"):
+        from . import torch as _torch_mod
+
+        return getattr(_torch_mod, name)
+    if name in ("KerasEstimator", "KerasModel"):
+        from . import keras as _keras_mod
+
+        return getattr(_keras_mod, name)
+    raise AttributeError(name)
+
+
 def _require_pyspark():
     try:
         import pyspark  # noqa: F401

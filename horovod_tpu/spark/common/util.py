@@ -1,0 +1,97 @@
+"""DataFrame materialization helpers for the Spark estimators.
+
+Reference: /root/reference/horovod/spark/common/util.py (747 LoC) prepares
+DataFrames by writing Parquet/Petastorm stores and building per-rank
+readers. TPU-native slimming: the estimators here materialize features to
+NumPy (the universal currency of jax/torch/keras) — a pandas DataFrame is
+handled directly, a pyspark DataFrame via ``toPandas()`` (small/medium
+data) so the estimator API works with or without a live Spark cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _is_spark_df(df) -> bool:
+    mod = type(df).__module__
+    return mod.startswith("pyspark.")
+
+
+def to_pandas(df):
+    """pandas passthrough; pyspark → toPandas() (driver-side collect)."""
+    if _is_spark_df(df):
+        return df.toPandas()
+    return df
+
+
+def dataframe_to_numpy(df, feature_cols: Sequence[str],
+                       label_cols: Optional[Sequence[str]] = None,
+                       dtype=np.float32) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Materialize ``df[feature_cols]`` (and labels) as dense arrays.
+
+    Columns holding vectors (lists/ndarrays per row) are stacked; scalar
+    columns become width-1 features and are concatenated in column order
+    (the moral of reference util.py's petastorm schema prep, without the
+    Parquet round-trip).
+    """
+    pdf = to_pandas(df)
+
+    def cols_to_array(cols) -> np.ndarray:
+        parts = []
+        for c in cols:
+            v = pdf[c].to_numpy()
+            if v.dtype == object:  # per-row vectors
+                part = np.stack([np.asarray(e, dtype=dtype) for e in v])
+                if part.ndim == 1:
+                    part = part[:, None]
+            else:
+                part = v.astype(dtype)[:, None]
+            parts.append(part)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+
+    x = cols_to_array(list(feature_cols))
+    y = cols_to_array(list(label_cols)) if label_cols else None
+    return x, y
+
+
+def attach_predictions(pdf, out: np.ndarray, output_cols: Sequence[str]):
+    """Write model outputs into DataFrame columns (shared by the torch and
+    keras model transformers).
+
+    - one output column + multi-width output → each row stores the full
+      output vector (reference estimators keep the row vector);
+    - k output columns + width-k output → one scalar column each;
+    - anything else is ambiguous → error, never silent truncation.
+    """
+    if out.ndim == 1:
+        out = out[:, None]
+    cols = list(output_cols)
+    if len(cols) == 1:
+        if out.shape[1] == 1:
+            pdf[cols[0]] = list(out[:, 0])
+        else:
+            pdf[cols[0]] = list(out)
+    elif len(cols) == out.shape[1]:
+        for i, c in enumerate(cols):
+            pdf[c] = list(out[:, i])
+    else:
+        raise ValueError(
+            f"{len(cols)} output_cols for model output width {out.shape[1]}")
+    return pdf
+
+
+def train_val_split(x: np.ndarray, y: Optional[np.ndarray],
+                    validation: Optional[float]):
+    """Tail-fraction validation split (reference estimators accept a
+    ``validation`` fraction/column; only the fraction form is kept)."""
+    if not validation:
+        return (x, y), (None, None)
+    n = len(x)
+    n_val = max(1, int(n * float(validation)))
+    cut = n - n_val
+    val_y = y[cut:] if y is not None else None
+    trn_y = y[:cut] if y is not None else None
+    return (x[:cut], trn_y), (x[cut:], val_y)

@@ -71,10 +71,57 @@ class KerasEstimator:
             return keras.models.load_model(p)
 
     def fit(self, df):
-        """Train on a Spark DataFrame (requires pyspark; reference
-        estimator.fit → per-executor training loop)."""
-        from . import _require_pyspark
+        """Train on a pandas or pyspark DataFrame (reference estimator.fit
+        → per-executor training loop; see spark/torch.py for the
+        materialization model). Returns a ``KerasModel`` transformer."""
+        from .common.util import dataframe_to_numpy
 
-        _require_pyspark()
-        raise NotImplementedError(
-            "DataFrame materialization requires a live Spark cluster")
+        if self.model is None or not self.feature_cols or not self.label_cols:
+            raise ValueError("model, feature_cols and label_cols are required")
+        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols)
+        if self.optimizer is not None or self.loss is not None:
+            # fill the unspecified half from the model's existing compile
+            # config; silently substituting a default (e.g. "mse" on a
+            # classifier) would train the wrong objective, so a missing
+            # half with no prior config is an error
+            opt = self.optimizer or getattr(self.model, "optimizer", None)
+            loss = self.loss or getattr(self.model, "loss", None)
+            if opt is None or loss is None:
+                raise ValueError(
+                    "estimator got only one of optimizer/loss and the "
+                    "model has no prior compile config for the other")
+            self.model.compile(optimizer=opt, loss=loss,
+                               metrics=self.metrics)
+        elif not getattr(self.model, "compiled", False):
+            raise ValueError(
+                "model is not compiled; pass optimizer= and loss= to the "
+                "estimator or compile the model first")
+        self.model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
+                       verbose=self.verbose)
+        if self.store is not None:
+            self.save_checkpoint()
+        return KerasModel(self.model, self.feature_cols)
+
+
+class KerasModel:
+    """Transformer returned by ``fit`` (reference spark/keras/estimator.py
+    KerasModel): appends prediction columns to the DataFrame."""
+
+    def __init__(self, model, feature_cols, output_cols=("prediction",)):
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.output_cols = list(output_cols)
+
+    def transform(self, df):
+        import numpy as np
+
+        from .common.util import (
+            attach_predictions,
+            dataframe_to_numpy,
+            to_pandas,
+        )
+
+        pdf = to_pandas(df).copy()
+        x, _ = dataframe_to_numpy(pdf, self.feature_cols)
+        out = np.asarray(self.model.predict(x, verbose=0))
+        return attach_predictions(pdf, out, self.output_cols)

@@ -339,6 +339,107 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         f"{type(optimizer)}")
 
 
+def DistributedAdasumOptimizer(optimizer, name=None,
+                               compression=Compression.none,
+                               backward_passes_per_step: int = 1):
+    """Delta-Adasum optimizer (reference tensorflow/__init__.py:502
+    _DistributedAdasumOptimizer): each worker applies its local updates;
+    every ``backward_passes_per_step``-th step the accumulated model
+    *delta* (var − start) is combined across workers with the
+    scale-invariant Adasum reduction and committed (start += global_delta;
+    var = start). TF2-eager re-design of the reference's tf.cond/slot graph
+    machinery."""
+    return _DistributedAdasumOptimizer(optimizer, name, compression,
+                                       backward_passes_per_step)
+
+
+class _DistributedAdasumOptimizer:
+    def __init__(self, optimizer, name, compression,
+                 backward_passes_per_step):
+        self._opt = optimizer
+        self._name = name or f"DistributedDelta{type(optimizer).__name__}"
+        self._compression = compression
+        self._bpps = int(backward_passes_per_step)
+        # graph-safe state: a tf.Variable step counter and per-variable
+        # "delta_start" snapshot variables keyed by v.ref() (the reference
+        # keeps these as optimizer slots + a step_count variable — :520).
+        # tf.Variable state survives tf.function tracing, unlike Python
+        # ints, so the commit branch stays live inside model.fit's
+        # compiled train_step; v.ref() is identity-stable (id() could be
+        # recycled after GC).
+        self._step_var: Optional[tf.Variable] = None
+        self._start: dict = {}
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _adasum_reduce_np(self, delta: np.ndarray, i: int) -> np.ndarray:
+        t, ctx = self._compression.compress(tf.convert_to_tensor(delta))
+        h = _core.allreduce_async(_to_np(t), None,
+                                  f"adasum.delta.{self._name}.{i}",
+                                  op=Adasum)
+        out = _from_np(_core.synchronize(h), t.dtype)
+        return np.asarray(self._compression.decompress(out, ctx))
+
+    def _pre_update(self, variables):
+        if self._step_var is None:
+            self._step_var = tf.Variable(0, dtype=tf.int64, trainable=False,
+                                         name="adasum_step_count")
+        for v in variables:
+            if v.ref() not in self._start:
+                self._start[v.ref()] = tf.Variable(
+                    v, trainable=False, name="adasum_delta_start")
+
+    def _post_update(self, variables):
+        self._step_var.assign_add(1)
+
+        def commit():
+            for i, v in enumerate(variables):
+                start = self._start[v.ref()]
+                local_delta = v - start
+                # the eager-runtime Adasum rides a py_function so the
+                # same code works traced (model.fit) and eager
+                global_delta = tf.py_function(
+                    lambda d, i=i: self._adasum_reduce_np(d.numpy(), i),
+                    [local_delta], local_delta.dtype)
+                global_delta.set_shape(v.shape)
+                new_start = start + tf.cast(global_delta, v.dtype)
+                start.assign(new_start)
+                v.assign(new_start)
+            return tf.constant(True)
+
+        tf.cond(tf.equal(self._step_var % self._bpps, 0),
+                commit, lambda: tf.constant(False))
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gvs = list(grads_and_vars)
+        variables = [v for _, v in gvs]
+        self._pre_update(variables)
+        result = self._opt.apply_gradients(gvs, **kwargs)
+        self._post_update(variables)
+        return result
+
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        """Keras 3's primary entry point — must be intercepted too, or a
+        caller reaching the base optimizer's apply() would update weights
+        without ever running the Adasum commit."""
+        if trainable_variables is None:
+            trainable_variables = getattr(self._opt,
+                                          "_trainable_variables", None)
+            if not trainable_variables:
+                raise ValueError(
+                    "DistributedAdasumOptimizer.apply needs "
+                    "trainable_variables until the base optimizer is built")
+        variables = list(trainable_variables)
+        self._pre_update(variables)
+        result = self._opt.apply(grads, variables, **kwargs)
+        self._post_update(variables)
+        return result
+
+    def variables(self, *args, **kwargs):
+        return self._opt.variables(*args, **kwargs)
+
+
 class _LegacyDistributedOptimizer(tf.compat.v1.train.Optimizer):
     """tf.compat.v1 path (reference tensorflow/__init__.py:599-663):
     compute_gradients → allreduce → apply."""

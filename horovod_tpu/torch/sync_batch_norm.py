@@ -54,9 +54,10 @@ class _SyncBatchNormFn(torch.autograd.Function):
         ctx.save_for_backward(input, mean, invstd, weight)
         ctx.bn_name = name
         ctx.dims = dims
-        # expose stats for the module's running-average update
-        ctx.mark_non_differentiable = ()
-        return out, mean.detach(), var.detach()
+        # stats are exposed only for the module's running-average update
+        mean_out, var_out = mean.detach(), var.detach()
+        ctx.mark_non_differentiable(mean_out, var_out)
+        return out, mean_out, var_out
 
     @staticmethod
     def backward(ctx, dy, _dmean, _dvar):
@@ -64,7 +65,6 @@ class _SyncBatchNormFn(torch.autograd.Function):
         dims = ctx.dims
         shape = [1, -1] + [1] * (input.dim() - 2)
         xhat = (input - mean.view(shape)) * invstd.view(shape)
-        n_local = input.numel() // input.shape[1]
         # per-feature gradient sums over the *global* batch: average the
         # per-worker means (equal local counts), reference
         # sync_batch_norm.py backward's allreduce of sum_dy / sum_dy_xmu
@@ -101,6 +101,10 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
     def forward(self, input):
         self._check_input_dim(input)
         if not self.training:
+            if self.running_mean is None:  # track_running_stats=False:
+                # torch BatchNorm falls back to batch statistics in eval
+                return F.batch_norm(input, None, None, self.weight,
+                                    self.bias, True, 0.0, self.eps)
             return F.batch_norm(
                 input, self.running_mean, self.running_var, self.weight,
                 self.bias, False, 0.0, self.eps)

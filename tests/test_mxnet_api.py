@@ -1,0 +1,96 @@
+"""MXNet adapter numerics (reference test/parallel/test_mxnet.py shape:
+collective numerics + optimizer gradient reduction). No mxnet wheel exists
+in this image, so the duck-typed numpy path is exercised — identical code
+paths to a real NDArray crossing the boundary via ``asnumpy()``."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hvd_mx
+
+
+class FakeNDArray(np.ndarray):
+    """Minimal NDArray stand-in: numpy + asnumpy()."""
+
+    def asnumpy(self):
+        return np.asarray(self)
+
+
+def _nd(x) -> FakeNDArray:
+    return np.asarray(x, dtype=np.float32).view(FakeNDArray)
+
+
+def test_allreduce_numerics():
+    # eager collectives reduce across *processes*; this suite runs one
+    # process, so sum == identity (same stance as test_tensorflow_api)
+    t = _nd([1.0, 2.0, 3.0])
+    out = hvd_mx.allreduce(t, average=True, name="mx.t.ar")
+    np.testing.assert_allclose(np.asarray(out), [1.0, 2.0, 3.0])
+    out = hvd_mx.allreduce(t, average=False, name="mx.t.ar2")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(t))
+
+
+def test_allreduce_inplace_and_prescale():
+    t = _nd([2.0, 4.0])
+    hvd_mx.allreduce_(t, average=True, name="mx.t.arip")
+    np.testing.assert_allclose(np.asarray(t), [2.0, 4.0])
+    out = hvd_mx.allreduce(_nd([2.0, 4.0]), average=False, name="mx.t.arps",
+                           prescale_factor=0.5)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 2.0])
+
+
+def test_broadcast_and_allgather():
+    t = _nd([[1.0, 2.0]])
+    out = hvd_mx.broadcast(t, root_rank=0, name="mx.t.bc")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(t))
+    gathered = hvd_mx.allgather(t, name="mx.t.ag")
+    assert np.asarray(gathered).shape == np.asarray(t).shape
+
+
+def test_alltoall_roundtrip():
+    t = _nd(np.arange(4, dtype=np.float32))
+    out, recv = hvd_mx.alltoall(t, name="mx.t.a2a")
+    assert np.asarray(out).size == t.size
+    assert int(np.asarray(recv).sum()) == t.size
+
+
+def test_broadcast_parameters_dict():
+    params = {"w": _nd([1.0, 2.0]), "b": _nd([3.0])}
+    hvd_mx.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(params["w"]), [1.0, 2.0])
+    with pytest.raises(ValueError):
+        hvd_mx.broadcast_parameters([1, 2, 3])
+
+
+def test_distributed_optimizer_reduces_then_updates():
+    calls = []
+
+    class FakeOpt:
+        learning_rate = 0.1
+
+        def update(self, index, weight, grad, state):
+            calls.append(("update", index))
+            ws = weight if isinstance(index, (tuple, list)) else [weight]
+            gs = grad if isinstance(index, (tuple, list)) else [grad]
+            for w_, g_ in zip(ws, gs):
+                w_ -= self.learning_rate * g_
+
+        def update_multi_precision(self, index, weight, grad, state):
+            calls.append(("ump", index))
+
+    opt = hvd_mx.DistributedOptimizer(FakeOpt())
+    assert opt.learning_rate == 0.1  # __getattr__ passthrough
+    w, g = _nd([1.0, 1.0]), _nd([0.5, 0.5])
+    opt.update(3, w, g, None)
+    assert calls[0][0] == "update" and calls[0][1] == 3
+    # size-1 world: averaged grad == original; weight got the sgd step
+    np.testing.assert_allclose(np.asarray(w), [0.95, 0.95])
+    # grouped index form
+    opt.update([1, 2], [w, w], [g, _nd([1.0, 1.0])], None)
+    assert calls[-1][1] == [1, 2]
+
+
+def test_distributed_trainer_gated_without_mxnet():
+    assert hvd_mx.MXNET_AVAILABLE is False
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.DistributedTrainer({}, "sgd")

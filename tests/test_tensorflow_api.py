@@ -190,3 +190,60 @@ def test_allreduce_is_differentiable():
     (g,) = tape.gradient(y, [x])
     # size=1: allreduce(x)=x, so y = sum(x^2), dy/dx = 2x
     np.testing.assert_allclose(g.numpy(), [4.0, 6.0])
+
+
+def test_distributed_adasum_optimizer_matches_local():
+    """Delta-Adasum optimizer (reference tensorflow/__init__.py:502): at
+    world size 1 the Adasum-combined delta equals the local delta, so the
+    wrapper must reproduce the base optimizer's trajectory exactly —
+    including across the backward_passes_per_step commit boundary."""
+    import keras
+
+    tf.random.set_seed(7)
+    w_ref = tf.Variable([1.0, -2.0, 3.0])
+    w_ada = tf.Variable([1.0, -2.0, 3.0])
+    base_ref = keras.optimizers.SGD(0.1)
+    base_ada = keras.optimizers.SGD(0.1)
+    ada = hvd.DistributedAdasumOptimizer(base_ada,
+                                         backward_passes_per_step=2)
+    assert "DistributedDeltaSGD" in ada._name
+    for step in range(4):
+        grad = tf.constant([0.5, -0.25, 1.0]) * float(step + 1)
+        base_ref.apply_gradients([(grad, w_ref)])
+        ada.apply_gradients([(grad, w_ada)])
+        np.testing.assert_allclose(w_ada.numpy(), w_ref.numpy(), rtol=1e-5,
+                                   err_msg=f"diverged at step {step}")
+    assert ada.learning_rate == base_ada.learning_rate  # passthrough
+
+
+def test_distributed_adasum_optimizer_inside_tf_function():
+    """The Adasum commit must survive tf.function tracing (the reference
+    wires it into v1 graph training the same way): step counter and
+    snapshots are tf.Variables and the reduction rides a py_function, so
+    with backward_passes_per_step=2 the commit executes on live steps
+    rather than being frozen out at trace time."""
+    import keras
+
+    keras.utils.set_random_seed(3)
+    x = tf.constant(np.random.RandomState(0).randn(64, 4), tf.float32)
+    y = tf.constant(
+        np.random.RandomState(0).randn(64, 4) @
+        np.random.RandomState(1).randn(4, 1), tf.float32)
+    w = tf.Variable(tf.zeros([4, 1]))
+    opt = hvd.DistributedAdasumOptimizer(keras.optimizers.SGD(0.05),
+                                         backward_passes_per_step=2)
+
+    @tf.function
+    def train_step():
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(x @ w - y))
+        grads = tape.gradient(loss, [w])
+        opt.apply_gradients(zip(grads, [w]))
+        return loss
+
+    losses = [float(train_step()) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    assert int(opt._step_var.numpy()) == 10
+    # commit ran on even steps: snapshot tracks the committed weights
+    (start_var,) = opt._start.values()
+    np.testing.assert_allclose(start_var.numpy(), w.numpy(), rtol=1e-5)
