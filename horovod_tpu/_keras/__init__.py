@@ -30,7 +30,9 @@ def _allreduce_np(values, op, prescale, postscale, prefix):
 def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                                  compression=None, op=None,
                                  gradient_predivide_factor: float = 1.0,
-                                 process_set=None):
+                                 process_set=None,
+                                 backward_passes_per_step: int = 1,
+                                 average_aggregated_gradients: bool = False):
     import keras
 
     op = _core.Average if op is None else op
@@ -47,6 +49,7 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
     cls = optimizer.__class__
     if getattr(cls, "_hvd_wrapped", False):
         raise ValueError("optimizer is already a DistributedOptimizer")
+    bpps = int(backward_passes_per_step)
 
     class _Distributed(cls):
         _hvd_wrapped = True
@@ -84,17 +87,69 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
             return [keras.ops.convert_to_tensor(r.astype(a.dtype))
                     for r, a in zip(reduced, arrs)]
 
-        def apply_gradients(self, grads_and_vars, **kwargs):
-            gv = list(grads_and_vars)
-            grads = self._hvd_reduce([g for g, _ in gv])
-            return super().apply_gradients(
-                [(g, v) for g, (_, v) in zip(grads, gv)], **kwargs)
+        # NOTE: apply_gradients is intentionally NOT overridden. Keras 3's
+        # BaseOptimizer.apply_gradients delegates to self.apply, so apply()
+        # is the single funnel — reducing in both would allreduce twice.
 
         def apply(self, grads, trainable_variables=None, **kwargs):
-            grads = self._hvd_reduce(list(grads))
-            if trainable_variables is None:
-                return super().apply(grads, **kwargs)
-            return super().apply(grads, trainable_variables, **kwargs)
+            grads = list(grads)
+            if bpps <= 1:
+                grads = self._hvd_reduce(grads)
+                if trainable_variables is None:
+                    return super().apply(grads, **kwargs)
+                return super().apply(grads, trainable_variables, **kwargs)
+            if keras.backend.backend() != "tensorflow":
+                # the aggregation state machine is tf.Variable/tf.cond
+                # based; a backend-neutral version would need per-backend
+                # stateful accumulators
+                raise NotImplementedError(
+                    "backward_passes_per_step > 1 requires the tensorflow "
+                    "keras backend (for JAX training loops use "
+                    "horovod_tpu.opt with gradient accumulation instead)")
+            return self._hvd_apply_aggregated(grads, trainable_variables,
+                                              **kwargs)
+
+        def _hvd_apply_aggregated(self, grads, trainable_variables,
+                                  **kwargs):
+            """Local gradient aggregation (reference
+            horovod/tensorflow/gradient_aggregation.py): accumulate
+            ``backward_passes_per_step`` local gradients, then allreduce
+            the aggregate and run the real update once. tf.Variable
+            counter + tf.cond keep the commit live inside a traced
+            train_step; on skipped steps the base optimizer does not run
+            at all (no slot/iteration pollution from zero grads)."""
+            import tensorflow as tf
+
+            if trainable_variables is not None:
+                self.build(list(trainable_variables))  # slots outside cond
+            if getattr(self, "_hvd_agg", None) is None:
+                self._hvd_agg = [
+                    tf.Variable(tf.zeros(g.shape, g.dtype), trainable=False)
+                    for g in grads
+                ]
+                self._hvd_counter = tf.Variable(0, dtype=tf.int64,
+                                                trainable=False)
+            for a, g in zip(self._hvd_agg, grads):
+                a.assign_add(tf.cast(g, a.dtype))
+            self._hvd_counter.assign_add(1)
+            base_apply = super(_Distributed, self).apply
+
+            def commit():
+                gs = [a.read_value() for a in self._hvd_agg]
+                if average_aggregated_gradients:
+                    gs = [g / float(bpps) for g in gs]
+                gs = self._hvd_reduce(gs)
+                if trainable_variables is None:
+                    base_apply(gs, **kwargs)
+                else:
+                    base_apply(gs, list(trainable_variables), **kwargs)
+                for a in self._hvd_agg:
+                    a.assign(tf.zeros(a.shape, a.dtype))
+                return tf.constant(True)
+
+            tf.cond(tf.equal(self._hvd_counter % bpps, 0),
+                    commit, lambda: tf.constant(False))
+            return self.iterations
 
     _Distributed.__name__ = name or f"Distributed{cls.__name__}"
     config = optimizer.get_config()

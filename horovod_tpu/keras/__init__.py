@@ -37,13 +37,18 @@ from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None):
+                         process_set=None,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False):
     """Dynamic-subclass optimizer wrap (reference keras/__init__.py:40 →
-    _keras/__init__.py:28-166)."""
+    _keras/__init__.py:28-166). ``backward_passes_per_step > 1`` turns on
+    local gradient aggregation (reference gradient_aggregation.py)."""
     return create_distributed_optimizer(
         optimizer, name=name, compression=compression, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
-        process_set=process_set)
+        process_set=process_set,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients)
 
 
 def broadcast_variables(variables, root_rank: int = 0):

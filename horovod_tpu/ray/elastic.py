@@ -1,38 +1,26 @@
-"""Elastic execution over Ray (or hermetic local processes).
+"""Elastic execution with Ray-backed host discovery.
 
 Reference: /root/reference/horovod/ray/elastic.py — `RayHostDiscovery`
 (:38, reads ``ray.nodes()`` and converts CPU/GPU resources to slots) and
 `ElasticRayExecutor` (:149, wires that discovery into the elastic driver
 and runs a user function across rendezvous rounds).
 
-TPU-native design: we reuse the restart-based `ElasticDriver`
-(``horovod_tpu.elastic.driver``) rather than re-rendezvousing inside
-worker processes — a JAX world is size-specialized, so each round
-launches fresh worker processes that restore committed `State`.
-
-Worker placement: every worker runs as a subprocess on the driver host
-(the hermetic engine — one process per slot, which is also the correct
+The round/launch/collect machinery is the shared
+`horovod_tpu.elastic.executor.ElasticFunctionExecutor`; this module adds
+the Ray discovery source. Worker placement: every worker runs as a
+subprocess on the driver host (one process per slot — also the correct
 shape for a single TPU host driving its local chips). Ray's role here is
-*discovery*: `RayHostDiscovery` turns the cluster's node table into the
-elastic slot map. Dispatching workers as remote Ray actors (the
-reference's BaseHorovodWorker placement) is not implemented — on a
-multi-node Ray cluster the slots still execute locally.
+*discovery*; dispatching workers as remote Ray actors (the reference's
+BaseHorovodWorker placement) is not implemented — on a multi-node Ray
+cluster the slots still execute locally.
 """
 
 from __future__ import annotations
 
-import os
-import pickle
-import subprocess
-import sys
-import tempfile
-from types import SimpleNamespace
-from typing import Callable, Optional
+from typing import Optional
 
 from ..elastic.discovery import FixedHosts, HostDiscovery
-from ..elastic.driver import ElasticDriver, WorkerHandle, make_base_env_fn
-from ..runner.hosts import SlotInfo
-from .runner import _serializer
+from ..elastic.executor import ElasticFunctionExecutor
 
 
 class RayHostDiscovery(HostDiscovery):
@@ -65,57 +53,26 @@ class RayHostDiscovery(HostDiscovery):
         return mapping
 
 
-class _SubprocessFnWorker(WorkerHandle):
-    """Runs the pickled user function in a subprocess on this host."""
-
-    def __init__(self, payload: str, out_path: str, env: dict):
-        code = (
-            "import pickle, sys\n"
-            f"sys.path[:0] = {list(sys.path)!r}\n"
-            f"fn, args, kwargs = pickle.load(open({payload!r}, 'rb'))\n"
-            "res = fn(*args, **kwargs)\n"
-            f"pickle.dump(res, open({out_path!r}, 'wb'))\n"
-        )
-        self._p = subprocess.Popen([sys.executable, "-c", code], env=env)
-
-    def poll(self):
-        return self._p.poll()
-
-    def terminate(self):
-        try:
-            self._p.terminate()
-        except ProcessLookupError:
-            pass
-
-
-class ElasticRayExecutor:
+class ElasticRayExecutor(ElasticFunctionExecutor):
     """Reference ray/elastic.py:149 surface: ``create_settings`` →
     ``start()`` → ``run(fn)`` → rank-ordered results of the final
     successful round."""
-
-    @staticmethod
-    def create_settings(min_np: int = 1, max_np: Optional[int] = None,
-                        reset_limit: Optional[int] = None, **kwargs):
-        return SimpleNamespace(min_np=min_np, max_np=max_np,
-                               reset_limit=reset_limit, **kwargs)
 
     def __init__(self, settings=None, use_gpu: bool = False,
                  cpus_per_slot: int = 1, gpus_per_slot: int = 1,
                  env_vars: Optional[dict] = None,
                  override_discovery: bool = True,
                  discovery: Optional[HostDiscovery] = None):
-        self.settings = settings or self.create_settings()
-        self.env_vars = dict(env_vars or {})
-        if discovery is not None:
-            self.discovery = discovery
-        elif override_discovery and self._ray_is_initialized():
-            self.discovery = RayHostDiscovery(use_gpu, cpus_per_slot,
-                                              gpus_per_slot)
-        else:
-            # hermetic fallback: all requested slots on this host
-            self.discovery = FixedHosts({"localhost": (
-                self.settings.max_np or self.settings.min_np)})
-        self.driver: Optional[ElasticDriver] = None
+        settings = settings or self.create_settings()
+        if discovery is None:
+            if override_discovery and self._ray_is_initialized():
+                discovery = RayHostDiscovery(use_gpu, cpus_per_slot,
+                                             gpus_per_slot)
+            else:
+                # hermetic fallback: all requested slots on this host
+                discovery = FixedHosts({"localhost": (
+                    settings.max_np or settings.min_np)})
+        super().__init__(settings, discovery, env_vars)
 
     @staticmethod
     def _ray_is_initialized() -> bool:
@@ -125,55 +82,3 @@ class ElasticRayExecutor:
             return ray.is_initialized()
         except ImportError:
             return False
-
-    def start(self):
-        self.driver = ElasticDriver(
-            self.discovery, min_np=self.settings.min_np,
-            max_np=self.settings.max_np,
-            reset_limit=getattr(self.settings, "reset_limit", None))
-
-    def run(self, fn: Callable, args: tuple = (),
-            kwargs: Optional[dict] = None) -> list:
-        """Run ``fn`` elastically; returns the final round's rank-ordered
-        results (reference ElasticRayExecutor.run)."""
-        if self.driver is None:
-            raise RuntimeError("call start() before run()")
-        driver = self.driver
-        workdir = tempfile.mkdtemp(prefix="hvd_ray_elastic_")
-        payload = os.path.join(workdir, "fn.pkl")
-        with open(payload, "wb") as f:
-            _serializer().dump((fn, args, kwargs or {}), f)
-
-        extra = dict(self.env_vars)
-        extra.setdefault(
-            "HOROVOD_ELASTIC_STORE",
-            os.path.join(workdir, "state.pkl"))
-        round_ranks: dict[int, list[int]] = {}
-
-        # workers all run on this machine (see module docstring), so a
-        # discovery hostname like a remote node IP must not leak into the
-        # worker's identity
-        base_env = make_base_env_fn(driver, extra,
-                                    hostname_override="localhost")
-
-        def create_worker(slot: SlotInfo, env: dict) -> WorkerHandle:
-            ep = driver._epoch
-            round_ranks.setdefault(ep, []).append(slot.rank)
-            out = os.path.join(workdir, f"out.{ep}.{slot.rank}.pkl")
-            return _SubprocessFnWorker(payload, out, env)
-
-        rc = driver.run(create_worker, base_env)
-        if rc != 0:
-            raise RuntimeError(f"elastic run failed with exit code {rc}")
-        final_ep = max(round_ranks)
-        results = []
-        for rank in sorted(round_ranks[final_ep]):
-            out = os.path.join(workdir, f"out.{final_ep}.{rank}.pkl")
-            with open(out, "rb") as f:
-                results.append(pickle.load(f))
-        return results
-
-    def shutdown(self):
-        if self.driver is not None:
-            self.driver.stop()
-            self.driver = None

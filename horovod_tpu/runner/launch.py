@@ -203,8 +203,50 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
     p.add_argument("--slots-per-host", type=int, default=1)
+    p.add_argument("--check-build", action="store_true",
+                   help="print framework/backend availability and exit "
+                        "(reference horovodrun --check-build)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     return p
+
+
+def check_build() -> str:
+    """Capability matrix (reference runner/launch.py check_build output
+    shape: Available Frameworks / Controllers / Tensor Operations)."""
+
+    def mark(flag: bool) -> str:
+        return "[X]" if flag else "[ ]"
+
+    def importable(mod: str) -> bool:
+        import importlib.util
+
+        return importlib.util.find_spec(mod) is not None
+
+    from .._native import lib as native_lib
+
+    lines = [
+        "Horovod-TPU v" + __import__("horovod_tpu").__version__,
+        "",
+        "Available Frameworks:",
+        f"    {mark(True)} JAX",
+        f"    {mark(importable('tensorflow'))} TensorFlow",
+        f"    {mark(importable('torch'))} PyTorch",
+        f"    {mark(importable('keras'))} Keras",
+        f"    {mark(importable('mxnet'))} MXNet",
+        "",
+        "Available Controllers:",
+        f"    {mark(True)} KV (HTTP rendezvous)",
+        f"    {mark(True)} XLA (compiled SPMD)",
+        "",
+        "Available Tensor Operations:",
+        f"    {mark(True)} XLA/ICI collectives",
+        f"    {mark(native_lib() is not None)} native C++ core",
+        "",
+        "Cluster Integrations:",
+        f"    {mark(importable('pyspark'))} Spark",
+        f"    {mark(importable('ray'))} Ray",
+    ]
+    return "\n".join(lines)
 
 
 def _apply_config_file(args):
@@ -245,6 +287,9 @@ def _knob_env(args) -> dict:
 def run_commandline(argv=None) -> int:
     args = make_parser().parse_args(argv)
     _apply_config_file(args)
+    if args.check_build:
+        print(check_build())
+        return 0
     command = args.command
     if command and command[0] == "--":
         command = command[1:]

@@ -101,10 +101,32 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
 
 def run_elastic(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 num_proc: Optional[int] = None, min_np: Optional[int] = None,
-                max_np: Optional[int] = None, **_):
-    """Elastic variant (reference spark/runner.py:306): delegated to the
-    elastic driver once a Spark cluster is present."""
-    _require_pyspark()
-    raise NotImplementedError(
-        "elastic Spark mode requires a live Spark cluster; use "
-        "horovod_tpu.elastic with hvdrun for elastic training here")
+                max_np: Optional[int] = None, env: Optional[dict] = None,
+                **_):
+    """Elastic variant (reference spark/runner.py:306) over the shared
+    elastic function executor. Worker placement is LOCAL: every slot runs
+    as a subprocess on the driver host (the executor's engine — same
+    limitation as the Ray elastic adapter, see ray/elastic.py docstring).
+    A live SparkContext only contributes the default process count; without
+    pyspark, pass ``num_proc`` explicitly for the same contract."""
+    from ..elastic.discovery import FixedHosts
+    from ..elastic.executor import ElasticFunctionExecutor
+
+    if num_proc is None:
+        pyspark = _require_pyspark()
+        from pyspark import SparkContext
+
+        sc = SparkContext._active_spark_context
+        if sc is None:
+            raise RuntimeError("no active SparkContext; create one first")
+        num_proc = max(int(sc.defaultParallelism), 1)
+    discovery = FixedHosts({"localhost": num_proc})
+
+    settings = ElasticFunctionExecutor.create_settings(
+        min_np=min_np or num_proc, max_np=max_np or num_proc)
+    ex = ElasticFunctionExecutor(settings, discovery, env_vars=env)
+    ex.start()
+    try:
+        return ex.run(fn, args, kwargs)
+    finally:
+        ex.shutdown()

@@ -114,12 +114,19 @@ class TorchEstimator:
         x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols)
         (x, y), (x_val, y_val) = train_val_split(x, y, self.validation)
 
+        if self.loss is None:
+            raise ValueError(
+                "TorchEstimator requires loss= (silently defaulting to MSE "
+                "would train a classifier on the wrong objective)")
         opt = self._make_optimizer()
         import horovod_tpu.torch as hvd_torch
 
+        # the torch shim's data-parallel/allreduce unit is the *process*
+        # (eager collectives reduce across processes; chips within a
+        # process are one worker), so sharding gates on cross_size
         distributed = False
         try:
-            if hvd_torch.is_initialized() and hvd_torch.size() > 1:
+            if hvd_torch.is_initialized() and hvd_torch.cross_size() > 1:
                 distributed = True
         except Exception:
             distributed = False
@@ -130,13 +137,13 @@ class TorchEstimator:
             hvd_torch.broadcast_parameters(self.model.state_dict(),
                                            root_rank=0)
 
-        loss_fn = self.loss or torch.nn.MSELoss()
+        loss_fn = self.loss
         xt = torch.from_numpy(np.ascontiguousarray(x))
         yt = torch.from_numpy(np.ascontiguousarray(y))
         if distributed:
-            # each rank trains its shard (reference: petastorm row-group
-            # sharding per rank)
-            r, n = hvd_torch.rank(), hvd_torch.size()
+            # each process trains its shard (reference: petastorm
+            # row-group sharding per rank)
+            r, n = hvd_torch.cross_rank(), hvd_torch.cross_size()
             xt, yt = xt[r::n], yt[r::n]
         self.model.train()
         for epoch in range(self.epochs):
@@ -164,6 +171,7 @@ class TorchEstimator:
 
             logging.getLogger("horovod_tpu").info(
                 "TorchEstimator validation loss %.5f", vl)
-        if self.store is not None and (not distributed or hvd_torch.rank() == 0):
+        if self.store is not None and (not distributed
+                                       or hvd_torch.cross_rank() == 0):
             self.save_checkpoint()
         return TorchModel(self.model, self.feature_cols)

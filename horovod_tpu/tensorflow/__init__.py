@@ -327,9 +327,11 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         from horovod_tpu._keras import create_distributed_optimizer
 
         return create_distributed_optimizer(
-            optimizer, compression=compression, op=op,
+            optimizer, name=name, compression=compression, op=op,
             gradient_predivide_factor=gradient_predivide_factor,
-            process_set=process_set)
+            process_set=process_set,
+            backward_passes_per_step=backward_passes_per_step,
+            average_aggregated_gradients=average_aggregated_gradients)
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
         return _LegacyDistributedOptimizer(
             optimizer, compression, op, gradient_predivide_factor,

@@ -45,6 +45,7 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
 from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.torch.elastic_sampler import ElasticSampler  # noqa: F401
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
 
 
@@ -476,14 +477,18 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
 # --- elastic TorchState (reference torch/elastic/state.py) ------------------
 
 class TorchState(ObjectState):
-    """Elastic state with torch model/optimizer handlers: snapshots are cpu
-    clones of state_dicts; sync broadcasts from rank 0."""
+    """Elastic state with torch model/optimizer/sampler handlers: snapshots
+    are cpu clones of state_dicts; sync broadcasts from rank 0 (and, for
+    the sampler, merges every worker's processed-index set — reference
+    torch/elastic/state.py SamplerStateHandler)."""
 
-    def __init__(self, model=None, optimizer=None, **kwargs):
+    def __init__(self, model=None, optimizer=None, sampler=None, **kwargs):
         self._model = model
         self._optimizer = optimizer
+        self._sampler = sampler
         self._model_saved = None
         self._opt_saved = None
+        self._sampler_saved = None
         super().__init__(**kwargs)
 
     def save(self):
@@ -492,6 +497,8 @@ class TorchState(ObjectState):
                                  for k, v in self._model.state_dict().items()}
         if self._optimizer is not None:
             self._opt_saved = copy.deepcopy(self._optimizer.state_dict())
+        if self._sampler is not None:
+            self._sampler_saved = copy.deepcopy(self._sampler.state_dict())
         super().save()
 
     def restore(self):
@@ -499,6 +506,8 @@ class TorchState(ObjectState):
             self._model.load_state_dict(self._model_saved)
         if self._opt_saved is not None:
             self._optimizer.load_state_dict(self._opt_saved)
+        if self._sampler_saved is not None:
+            self._sampler.load_state_dict(self._sampler_saved)
         super().restore()
 
     def sync(self):
@@ -506,4 +515,14 @@ class TorchState(ObjectState):
             broadcast_parameters(self._model.state_dict(), root_rank=0)
         if self._optimizer is not None:
             broadcast_optimizer_state(self._optimizer, root_rank=0)
+        if self._sampler is not None:
+            # after a resize no single worker knows the full progress:
+            # union everyone's processed indices, then re-shard
+            st = self._sampler.state_dict()
+            all_states = allgather_object(st)
+            merged = set()
+            for s in all_states if isinstance(all_states, list) else [st]:
+                merged.update(s.get("processed_indices", ()))
+            st["processed_indices"] = sorted(merged)
+            self._sampler.load_state_dict(st)
         super().sync()

@@ -206,3 +206,14 @@ def test_keras_estimator_fit_transform(tmp_path):
     out = km.transform(df)
     pred = np.asarray(list(out["prediction"]), np.float32)
     assert float(np.mean((pred - y) ** 2)) < 0.1
+
+
+def test_spark_run_elastic_hermetic():
+    """spark.run_elastic without pyspark: num_proc local slots through the
+    shared elastic function executor (reference spark/runner.py:306
+    contract — results are rank-ordered)."""
+    import horovod_tpu.spark as hvd_spark
+
+    results = hvd_spark.run_elastic(_elastic_fn, args=("s",), num_proc=2)
+    assert [r[0] for r in results] == ["s", "s"]
+    assert [r[1] for r in results] == ["0", "1"]

@@ -77,3 +77,45 @@ def test_load_model_rewraps_optimizer(tmp_path):
     assert getattr(loaded.optimizer.__class__, "_hvd_wrapped", False)
     # still trainable after the rewrap
     loaded.fit(X, y, epochs=1, batch_size=16, verbose=0)
+
+
+def test_backward_passes_per_step_aggregates():
+    """Local gradient aggregation (reference tensorflow/
+    gradient_aggregation.py): with backward_passes_per_step=2, the base
+    update runs every 2nd call on the (optionally averaged) aggregate and
+    skipped calls leave weights and optimizer iterations untouched."""
+    import keras
+    import numpy as np
+    import tensorflow as tf
+
+    w = tf.Variable([1.0, 2.0])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1),
+                                   backward_passes_per_step=2,
+                                   average_aggregated_gradients=True)
+    g1 = tf.constant([1.0, 1.0])
+    g2 = tf.constant([3.0, 5.0])
+    opt.apply([g1], [w])
+    np.testing.assert_allclose(w.numpy(), [1.0, 2.0])  # skipped step
+    opt.apply([g2], [w])
+    # committed: avg aggregate = (g1+g2)/2 = [2,3]; sgd step 0.1
+    np.testing.assert_allclose(w.numpy(), [0.8, 1.7], rtol=1e-6)
+    assert int(opt.iterations.numpy()) == 1  # base ran once
+
+
+def test_backward_passes_per_step_inside_model_fit():
+    """Aggregation must survive model.fit's traced train_step: the counter
+    is a tf.Variable and the commit a tf.cond."""
+    import keras
+    import numpy as np
+
+    keras.utils.set_random_seed(0)
+    x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = (x @ np.random.RandomState(1).randn(4, 1).astype(np.float32))
+    model = keras.Sequential([keras.Input((4,)), keras.layers.Dense(1)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(0.05),
+                                   backward_passes_per_step=2)
+    model.compile(optimizer=opt, loss="mse")
+    hist = model.fit(x, y, batch_size=16, epochs=6, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    # 6 epochs x 4 batches = 24 calls → 12 real optimizer steps
+    assert int(opt.iterations.numpy()) == 12

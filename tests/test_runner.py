@@ -129,3 +129,15 @@ def test_programmatic_run():
         return int(os.environ["HOROVOD_RANK"]) * x
 
     assert run(fn, args=(10,), np=2) == [0, 10]
+
+
+def test_check_build_output(capsys):
+    """hvdrun --check-build prints the capability matrix and exits 0
+    (reference horovodrun --check-build)."""
+    from horovod_tpu.runner.launch import run_commandline
+
+    assert run_commandline(["--check-build"]) == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "Available Tensor Operations" in out

@@ -242,3 +242,56 @@ def test_sync_batch_norm_matches_torch_bn():
                                 torch.nn.BatchNorm1d(4))
     conv = hvd.SyncBatchNorm.convert_sync_batchnorm(model)
     assert isinstance(conv[1], hvd.SyncBatchNorm)
+
+
+def test_elastic_sampler_shard_and_record():
+    """ElasticSampler (reference torch/elastic/sampler.py): shards the
+    dataset, tracks processed indices, and excludes them after reset."""
+    from horovod_tpu.torch import ElasticSampler
+
+    data = list(range(20))
+    s = ElasticSampler(data, shuffle=False)
+    assert len(s) == 20  # single process world: all samples here
+    first_two_batches = s.get_indices(0, 4) + s.get_indices(1, 4)
+    s.record_batch(0, 4)
+    s.record_batch(1, 4)
+    assert s.state_dict()["processed_indices"] == sorted(first_two_batches)
+    # mid-epoch reset (elastic restart): remaining excludes processed
+    s.reset()
+    assert len(s.indices) == 12
+    assert not set(s.indices) & set(first_two_batches)
+    # new epoch clears progress
+    s.set_epoch(1)
+    assert len(s.indices) == 20
+
+
+def test_elastic_sampler_shuffle_deterministic_and_state_roundtrip():
+    from horovod_tpu.torch import ElasticSampler
+
+    a = ElasticSampler(list(range(16)), shuffle=True, seed=7)
+    b = ElasticSampler(list(range(16)), shuffle=True, seed=7)
+    assert a.indices == b.indices  # same seed+epoch → same order
+    a.set_epoch(1)
+    b.set_epoch(2)
+    assert a.indices != b.indices  # epoch changes the permutation
+    a.record_indices(a.indices[:5])
+    st = a.state_dict()
+    c = ElasticSampler(list(range(16)), shuffle=True, seed=7)
+    c.load_state_dict(st)
+    assert c.epoch == 1 and len(c.indices) == 11
+
+
+def test_torch_state_syncs_sampler_progress():
+    """TorchState.sync unions processed indices (single-process: identity)
+    and re-shards (reference SamplerStateHandler)."""
+    from horovod_tpu.torch import ElasticSampler, TorchState
+
+    s = ElasticSampler(list(range(10)), shuffle=False)
+    s.record_batch(0, 3)
+    state = TorchState(sampler=s)
+    state.save()
+    state.sync()
+    assert len(s.indices) == 7
+    s.record_batch(0, 2)  # more progress, then restore the snapshot
+    state.restore()
+    assert len(s.state_dict()["processed_indices"]) == 3
