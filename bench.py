@@ -60,14 +60,27 @@ def _sync(x) -> float:
     return float(jnp.asarray(x).reshape(-1)[0])
 
 
-def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30):
+def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
+                 scan_steps: int = 1, model_fn=None, image_size: int = 224,
+                 num_classes: int = 1000):
+    """Full training-step throughput.
+
+    ``scan_steps > 1`` runs that many optimizer steps per dispatch under
+    ``lax.scan`` (same data each sub-step — synthetic-benchmark
+    convention). On a tunneled/remote chip this separates device
+    throughput from per-dispatch round-trip latency; on a local host the
+    two modes converge.
+    """
     n = hvd.size()
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model = (model_fn or (lambda: ResNet50(num_classes=num_classes,
+                                           dtype=jnp.bfloat16)))()
     rng = jax.random.PRNGKey(0)
     batch = per_chip_batch * n
     images = jnp.asarray(
-        np.random.RandomState(0).randn(batch, 224, 224, 3), jnp.bfloat16)
-    labels = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
+        np.random.RandomState(0).randn(batch, image_size, image_size, 3),
+        jnp.bfloat16)
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, num_classes, (batch,)))
 
     variables = model.init(rng, images[:2], train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -75,21 +88,36 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30):
     opt_state = opt.init(params)
     params = hvd.broadcast_parameters(params, root_rank=0)
 
-    def step(train_state, opt_state, images, labels):
-        params, batch_stats = train_state
-
+    def one_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, upd = model.apply(
                 {"params": p, "batch_stats": batch_stats}, images, train=True,
                 mutable=["batch_stats"])
-            onehot = jax.nn.one_hot(labels, 1000)
+            onehot = jax.nn.one_hot(labels, num_classes)
             loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
             return loss, upd["batch_stats"]
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return (params, new_stats), opt_state, jax.lax.pmean(loss, "hvd")
+        return params, new_stats, opt_state, loss
+
+    def step(train_state, opt_state, images, labels):
+        params, batch_stats = train_state
+        if scan_steps <= 1:
+            params, batch_stats, opt_state, loss = one_step(
+                params, batch_stats, opt_state, images, labels)
+        else:
+            def body(carry, _):
+                p, b, s = carry
+                p, b, s, loss = one_step(p, b, s, images, labels)
+                return (p, b, s), loss
+
+            (params, batch_stats, opt_state), losses = jax.lax.scan(
+                body, (params, batch_stats, opt_state), None,
+                length=scan_steps)
+            loss = losses[-1]
+        return (params, batch_stats), opt_state, jax.lax.pmean(loss, "hvd")
 
     compiled = data_parallel_step(step, batch_argnums=(2, 3))
     state = (params, batch_stats)
@@ -101,7 +129,7 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30):
         state, opt_state, loss = compiled(state, opt_state, images, labels)
     _sync(loss)
     dt = time.perf_counter() - t0
-    img_per_sec = batch * iters / dt
+    img_per_sec = batch * iters * max(scan_steps, 1) / dt
     return img_per_sec / n
 
 
@@ -189,8 +217,10 @@ def main():
     hvd.init()
     quick = "--quick" in sys.argv  # CPU/CI smoke: tiny sizes
     per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else 256)
+    scan_steps = _sync_int_env("HVD_BENCH_SCAN_STEPS", 1 if quick else 4)
     per_chip_ips = bench_resnet(per_chip, warmup=2 if quick else 5,
-                                iters=3 if quick else 30)
+                                iters=3 if quick else 8,
+                                scan_steps=scan_steps)
     flops = per_chip_ips * RESNET50_FWD_FLOP_PER_IMG * TRAIN_FLOP_MULT
     mfu = flops / chip_peak_flops()
     extras = {
@@ -203,6 +233,7 @@ def main():
         "moe_alltoall_ms": round(bench_moe_alltoall(
             256 if quick else 2048, 128 if quick else 512), 2),
         "per_chip_batch": per_chip,
+        "scan_steps": scan_steps,
         "device": jax.devices()[0].device_kind,
     }
     print(json.dumps({

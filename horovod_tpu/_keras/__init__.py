@@ -2,10 +2,12 @@
 
 ``create_distributed_optimizer`` dynamically subclasses the wrapped Keras
 optimizer's own class (reference _keras/__init__.py:28-166) so
-isinstance-based integrations keep working, and intercepts
-``apply_gradients``/``apply`` to allreduce gradients across workers first.
-Works with Keras 3 (the installed generation) under any backend whose
-gradients materialize as host-convertible arrays.
+isinstance-based integrations keep working, and intercepts ``apply`` —
+the single funnel in Keras 3 (``apply_gradients`` delegates to it) — to
+allreduce gradients across workers first. Works with Keras 3 (the
+installed generation) under any backend whose gradients materialize as
+host-convertible arrays; ``backward_passes_per_step > 1`` additionally
+aggregates locally (TensorFlow backend only).
 """
 
 from __future__ import annotations

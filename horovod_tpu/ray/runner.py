@@ -22,16 +22,7 @@ from ..common import env as env_schema
 from ..runner.http_server import RendezvousServer
 
 
-def _serializer():
-    """cloudpickle when available (serializes __main__-defined and lambda
-    functions by value, like the reference's use of cloudpickle in
-    spark/ray); plain pickle otherwise."""
-    try:
-        import cloudpickle
-
-        return cloudpickle
-    except ImportError:
-        return pickle
+from ..elastic.executor import _serializer  # noqa: E402  (shared helper)
 
 
 class Coordinator:

@@ -1,0 +1,8 @@
+"""``python -m horovod_tpu.runner`` == the ``hvdrun`` CLI."""
+
+import sys
+
+from .launch import run_commandline
+
+if __name__ == "__main__":
+    sys.exit(run_commandline())

@@ -333,6 +333,10 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
             backward_passes_per_step=backward_passes_per_step,
             average_aggregated_gradients=average_aggregated_gradients)
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        if backward_passes_per_step != 1:
+            raise NotImplementedError(
+                "backward_passes_per_step > 1 is supported for Keras "
+                "optimizers only; the tf.compat.v1 path applies every step")
         return _LegacyDistributedOptimizer(
             optimizer, compression, op, gradient_predivide_factor,
             sparse_as_dense, process_set, name, use_locking)

@@ -189,3 +189,55 @@ def test_stall_inspector_warns_and_shuts_down():
     si2.check()  # warns, no raise
     si2.record_done("tensor.y")
     si2.check()
+
+
+# --- sharded data loader ----------------------------------------------------
+
+def test_sharded_loader_batches_and_prefetch():
+    """ShardedLoader: shard → batch → prefetch-to-device (single process:
+    shard is identity; device arrays come back in order)."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.utils.data import ShardedLoader, shard_arrays
+
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.int32)
+    loader = ShardedLoader((x, y), batch_size=8, shuffle=False)
+    assert len(loader) == 2  # drop_remainder
+    batches = list(loader.epoch(0))
+    assert len(batches) == 2
+    bx, by = batches[0]
+    assert isinstance(bx, jax.Array) and bx.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(by), np.arange(8))
+    # shuffled epochs are deterministic per epoch and differ across epochs
+    l2 = ShardedLoader((x, y), batch_size=8, shuffle=True, prefetch=0)
+    e0 = [np.asarray(b[1]) for b in l2.epoch(0)]
+    e0_again = [np.asarray(b[1]) for b in l2.epoch(0)]
+    e1 = [np.asarray(b[1]) for b in l2.epoch(1)]
+    np.testing.assert_array_equal(np.concatenate(e0), np.concatenate(e0_again))
+    assert not np.array_equal(np.concatenate(e0), np.concatenate(e1))
+    # explicit shard math
+    shards = shard_arrays([np.arange(10)], shard_id=1, num_shards=2)
+    np.testing.assert_array_equal(shards[0], [1, 3, 5, 7, 9])
+
+
+def test_bench_resnet_scan_equivalence():
+    """bench.py's scan_steps mode must measure the same training step:
+    a tiny ResNet with scan_steps=2 runs 2x the optimizer steps per
+    dispatch and both modes return sane throughput."""
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import jax.numpy as jnp
+
+    import bench
+    from horovod_tpu.models.resnet import ResNet
+
+    tiny = lambda: ResNet(stage_sizes=[1, 1], num_filters=8,  # noqa: E731
+                          num_classes=10, dtype=jnp.bfloat16)
+    ips1 = bench.bench_resnet(2, warmup=1, iters=2, scan_steps=1,
+                              model_fn=tiny, image_size=32, num_classes=10)
+    ips2 = bench.bench_resnet(2, warmup=1, iters=1, scan_steps=2,
+                              model_fn=tiny, image_size=32, num_classes=10)
+    assert ips1 > 0 and ips2 > 0

@@ -1,0 +1,61 @@
+"""Examples as smoke tests (reference CI runs examples this way —
+.buildkite/gen-pipeline.sh:172-212). Each example launches in a
+subprocess (multi-process ones through ``hvdrun -np 2``) with the CPU
+platform forced for workers."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _env():
+    e = dict(os.environ)
+    # CPU-only smoke: force the cpu platform in workers and stop the TPU
+    # plugin's sitecustomize hook from dialing the device tunnel
+    e["JAX_PLATFORMS"] = "cpu"
+    e.pop("PALLAS_AXON_POOL_IPS", None)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    return e
+
+
+def _run(argv, timeout=420):
+    p = subprocess.run(argv, env=_env(), cwd=REPO, capture_output=True,
+                       text=True, timeout=timeout)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    return p.stdout
+
+
+def _hvdrun(np_, script, *args):
+    return _run([sys.executable, "-m", "horovod_tpu.runner", "-np",
+                 str(np_), "--env", "JAX_PLATFORMS=cpu", "--env",
+                 "PALLAS_AXON_POOL_IPS=", sys.executable,
+                 os.path.join(EXAMPLES, script), *args])
+
+
+def test_tensorflow2_mnist_two_proc():
+    out = _hvdrun(2, "tensorflow2_mnist.py", "--steps", "6",
+                  "--batch", "32")
+    assert "step" in out  # training-progress lines from rank 0
+
+
+def test_pytorch_mnist_two_proc():
+    _hvdrun(2, "pytorch_mnist.py", "--epochs", "1", "--batch-size", "64")
+
+
+def test_jax_mnist_single_proc():
+    _run([sys.executable, os.path.join(EXAMPLES, "jax_mnist.py"),
+          "--epochs", "1", "--batch-size", "32"])
+
+
+def test_adasum_example():
+    _run([sys.executable, os.path.join(EXAMPLES, "adasum_jax.py"),
+          "--steps", "5", "--batch", "32"])
+
+
+def test_ray_and_spark_examples():
+    _run([sys.executable, os.path.join(EXAMPLES, "ray_run.py"),
+          "--workers", "2", "--steps", "2"])
+    _run([sys.executable, os.path.join(EXAMPLES, "spark_estimator.py")])
