@@ -223,7 +223,12 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
 
         from ..utils.timeline import Timeline
 
-        _ctx.timeline = Timeline(_ctx.config.timeline_filename,
+        # the reference's timeline is recorded by the coordinator only
+        # (operations.cc BackgroundThreadLoop gates on rank 0); same here —
+        # also prevents same-host ranks clobbering one file
+        tl_file = (_ctx.config.timeline_filename
+                   if _ctx.global_set.cross_rank == 0 else "")
+        _ctx.timeline = Timeline(tl_file,
                                  mark_cycles=_ctx.config.timeline_mark_cycles)
 
         if start_runtime:
@@ -329,11 +334,23 @@ def rank() -> int:
 
 
 def local_size() -> int:
-    return _require_init().global_set.local_size
+    """Under a launcher (multi-process-per-host), the number of worker
+    processes on this host (launcher-injected env, reference
+    gloo_context.cc:136-192 consumption); standalone, the chips this
+    process drives — the TPU-sensible analogue."""
+    ctx = _require_init()
+    v = os.environ.get(env_schema.HOROVOD_LOCAL_SIZE)
+    if v is not None:
+        return int(v)
+    return ctx.global_set.local_size
 
 
 def local_rank() -> int:
-    return 0 if _require_init().global_set.local_size > 0 else -1
+    ctx = _require_init()
+    v = os.environ.get(env_schema.HOROVOD_LOCAL_RANK)
+    if v is not None:
+        return int(v)
+    return 0 if ctx.global_set.local_size > 0 else -1
 
 
 def cross_size() -> int:

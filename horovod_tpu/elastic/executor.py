@@ -29,14 +29,21 @@ from .driver import ElasticDriver, WorkerHandle, make_base_env_fn
 from ..runner.hosts import SlotInfo
 
 
-def _serializer():
+def _serializer(require_by_value: bool = False):
     """cloudpickle when available (serializes __main__-defined and lambda
-    functions by value); plain pickle otherwise."""
+    functions by value); plain pickle otherwise. Pass
+    ``require_by_value=True`` when the payload contains closures/lambdas
+    (the estimators' worker functions) so the failure is a clear error
+    rather than a pickling traceback."""
     try:
         import cloudpickle
 
         return cloudpickle
     except ImportError:
+        if require_by_value:
+            raise ImportError(
+                "this code path serializes closures and requires the "
+                "`cloudpickle` package")
         return pickle
 
 

@@ -18,7 +18,7 @@ class KerasEstimator:
                  store: Optional[Store] = None, num_proc: Optional[int] = None,
                  batch_size: int = 32, epochs: int = 1,
                  feature_cols=None, label_cols=None, run_id: str = "run0",
-                 verbose: int = 1):
+                 verbose: int = 1, backend_env: Optional[dict] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -31,6 +31,8 @@ class KerasEstimator:
         self.label_cols = label_cols
         self.run_id = run_id
         self.verbose = verbose
+        # extra env for estimator-launched workers (e.g. JAX_PLATFORMS)
+        self.backend_env = dict(backend_env or {})
 
     def checkpoint_path(self) -> str:
         if self.store is None:
@@ -96,8 +98,101 @@ class KerasEstimator:
             raise ValueError(
                 "model is not compiled; pass optimizer= and loss= to the "
                 "estimator or compile the model first")
+        import os
+
+        if (self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            return self._fit_multiproc(x, y)
+
+        # under a launcher (hvdrun): data-parallel in-process fit — wrap
+        # the compiled optimizer, shard, broadcast initial weights, and
+        # let only rank 0 touch the shared checkpoint (mirrors the torch
+        # estimator's distributed branch)
+        import horovod_tpu.keras as hvd_keras
+
+        distributed = False
+        if "HOROVOD_RANK" in os.environ:
+            if not hvd_keras.is_initialized():
+                hvd_keras.init()
+            distributed = hvd_keras.cross_size() > 1
+        callbacks = []
+        if distributed:
+            if not getattr(self.model.optimizer.__class__, "_hvd_wrapped",
+                           False):
+                self.model.compile(
+                    optimizer=hvd_keras.DistributedOptimizer(
+                        self.model.optimizer),
+                    loss=self.model.loss, metrics=self.metrics)
+            r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
+            x, y = x[r::n], y[r::n]
+            callbacks = [
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
         self.model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
-                       verbose=self.verbose)
+                       callbacks=callbacks, verbose=self.verbose)
+        if self.store is not None and (
+                not distributed or hvd_keras.cross_rank() == 0):
+            self.save_checkpoint()
+        return KerasModel(self.model, self.feature_cols)
+
+    def _fit_multiproc(self, x, y):
+        """Launch ``num_proc`` worker processes (reference
+        spark/keras/remote.py per-rank trainer): the model travels as
+        ``.keras`` bytes, each worker re-compiles with the distributed
+        optimizer wrap + broadcast callback and fits its shard; rank 0's
+        trained weights come back to the driver model."""
+        import os
+        import tempfile
+
+        from ..elastic.discovery import FixedHosts
+        from ..elastic.executor import ElasticFunctionExecutor, _serializer
+
+        _serializer(require_by_value=True)  # clear pre-flight error
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.keras")
+            self.model.save(p)
+            with open(p, "rb") as f:
+                model_bytes = f.read()
+        cfg = dict(batch_size=self.batch_size, epochs=self.epochs,
+                   verbose=self.verbose)
+
+        def worker(model_bytes, x, y, cfg):
+            import os
+            import tempfile
+
+            import keras
+
+            import horovod_tpu.keras as hvd_keras
+
+            hvd_keras.init()
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "m.keras")
+                with open(p, "wb") as f:
+                    f.write(model_bytes)
+                # load_model re-wraps the deserialized optimizer as a
+                # DistributedOptimizer
+                model = hvd_keras.load_model(p)
+            r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
+            callbacks = [
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
+            model.fit(x[r::n], y[r::n], batch_size=cfg["batch_size"],
+                      epochs=cfg["epochs"], callbacks=callbacks,
+                      verbose=cfg["verbose"] if r == 0 else 0)
+            if r == 0:
+                return model.get_weights()
+            return None
+
+        settings = ElasticFunctionExecutor.create_settings(
+            min_np=self.num_proc, max_np=self.num_proc)
+        ex = ElasticFunctionExecutor(
+            settings, FixedHosts({"localhost": self.num_proc}),
+            env_vars=dict(self.backend_env))
+        ex.start()
+        try:
+            results = ex.run(worker, args=(model_bytes, x, y, cfg))
+        finally:
+            ex.shutdown()
+        weights = next(r for r in results if r is not None)
+        self.model.set_weights(weights)
         if self.store is not None:
             self.save_checkpoint()
         return KerasModel(self.model, self.feature_cols)

@@ -55,7 +55,8 @@ class TorchEstimator:
                  validation: Optional[float] = None,
                  batch_size: int = 32, epochs: int = 1,
                  store: Optional[Store] = None, run_id: str = "run0",
-                 backward_passes_per_step: int = 1, verbose: int = 1):
+                 backward_passes_per_step: int = 1, verbose: int = 1,
+                 backend_env: Optional[dict] = None):
         self.num_proc = num_proc
         self.model = model
         self.optimizer = optimizer  # instance or factory(params)->optimizer
@@ -69,6 +70,8 @@ class TorchEstimator:
         self.run_id = run_id
         self.backward_passes_per_step = backward_passes_per_step
         self.verbose = verbose
+        # extra env for estimator-launched workers (e.g. JAX_PLATFORMS)
+        self.backend_env = dict(backend_env or {})
 
     # -- checkpoints (Store-backed, reference spark/common/store.py) --------
     def checkpoint_path(self) -> str:
@@ -109,6 +112,8 @@ class TorchEstimator:
         import numpy as np
         import torch
 
+        import os
+
         if self.model is None or not self.feature_cols or not self.label_cols:
             raise ValueError("model, feature_cols and label_cols are required")
         x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols)
@@ -118,6 +123,13 @@ class TorchEstimator:
             raise ValueError(
                 "TorchEstimator requires loss= (silently defaulting to MSE "
                 "would train a classifier on the wrong objective)")
+        if (self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            # estimator-launched distributed fit: spawn num_proc worker
+            # processes (the reference estimator launches
+            # horovod.spark.run the same way); each worker re-enters this
+            # method with a live hvd world and takes the sharded branch
+            return self._fit_multiproc(x, y, x_val, y_val)
         opt = self._make_optimizer()
         import horovod_tpu.torch as hvd_torch
 
@@ -162,16 +174,71 @@ class TorchEstimator:
 
                 logging.getLogger("horovod_tpu").info(
                     "TorchEstimator epoch %d loss %.5f", epoch, total)
-        if x_val is not None and self.verbose:
-            self.model.eval()
-            with torch.no_grad():
-                vl = float(loss_fn(self.model(torch.from_numpy(x_val)),
-                                   torch.from_numpy(y_val)))
-            import logging
-
-            logging.getLogger("horovod_tpu").info(
-                "TorchEstimator validation loss %.5f", vl)
+        self._log_validation(x_val, y_val)
         if self.store is not None and (not distributed
                                        or hvd_torch.cross_rank() == 0):
+            self.save_checkpoint()
+        return TorchModel(self.model, self.feature_cols)
+
+    def _log_validation(self, x_val, y_val):
+        if x_val is None or not self.verbose:
+            return
+        import logging
+
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            vl = float(self.loss(self.model(torch.from_numpy(x_val)),
+                                 torch.from_numpy(y_val)))
+        logging.getLogger("horovod_tpu").info(
+            "TorchEstimator validation loss %.5f", vl)
+
+    def _fit_multiproc(self, x, y, x_val, y_val):
+        """Launch ``num_proc`` local worker processes through the shared
+        elastic function executor; workers train the sharded loop with
+        gradients allreduced by the torch shim, rank 0 returns the trained
+        state_dict (reference spark/torch/remote.py's per-rank trainer +
+        driver-side model collection)."""
+        import pandas as pd
+
+        from ..elastic.discovery import FixedHosts
+        from ..elastic.executor import ElasticFunctionExecutor, _serializer
+
+        _serializer(require_by_value=True)  # clear pre-flight error
+
+        est = TorchEstimator(
+            model=self.model, optimizer=self.optimizer, loss=self.loss,
+            feature_cols=["__f"], label_cols=["__y"],
+            batch_size=self.batch_size, epochs=self.epochs,
+            backward_passes_per_step=self.backward_passes_per_step,
+            verbose=self.verbose)
+
+        def worker(est, x, y):
+            import horovod_tpu
+
+            horovod_tpu.init()
+            import horovod_tpu.torch as hvd_torch
+
+            df = pd.DataFrame({"__f": list(x), "__y": list(y)})
+            est.fit(df)
+            if hvd_torch.cross_rank() == 0:
+                return {k: v.cpu() for k, v in est.model.state_dict().items()}
+            return None
+
+        settings = ElasticFunctionExecutor.create_settings(
+            min_np=self.num_proc, max_np=self.num_proc)
+        ex = ElasticFunctionExecutor(
+            settings, FixedHosts({"localhost": self.num_proc}),
+            env_vars=dict(self.backend_env or {}))
+        ex.start()
+        try:
+            results = ex.run(worker, args=(est, x, y))
+        finally:
+            ex.shutdown()
+        state = next(r for r in results if r is not None)
+        self.model.load_state_dict(state)
+        self._log_validation(x_val, y_val)
+        if self.store is not None:
             self.save_checkpoint()
         return TorchModel(self.model, self.feature_cols)

@@ -217,3 +217,56 @@ def test_spark_run_elastic_hermetic():
     results = hvd_spark.run_elastic(_elastic_fn, args=("s",), num_proc=2)
     assert [r[0] for r in results] == ["s", "s"]
     assert [r[1] for r in results] == ["0", "1"]
+
+
+def test_torch_estimator_multiproc_fit(tmp_path):
+    """num_proc=2 estimator fit: the estimator launches two worker
+    processes, each trains its shard with allreduced gradients, and the
+    driver-side model receives rank 0's trained weights (reference
+    estimator → horovod.spark.run → remote trainer shape)."""
+    pandas = pytest.importorskip("pandas")
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import FilesystemStore, TorchEstimator
+
+    torch.manual_seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 3).astype(np.float32)
+    y = x @ np.ones((3, 1), np.float32)
+    df = pandas.DataFrame({"features": list(x), "label": list(y[:, 0])})
+    store = FilesystemStore(str(tmp_path / "st"))
+    est = TorchEstimator(
+        model=torch.nn.Linear(3, 1),
+        optimizer=lambda p: torch.optim.Adam(p, lr=0.05),
+        loss=torch.nn.MSELoss(), feature_cols=["features"],
+        label_cols=["label"], batch_size=16, epochs=30, num_proc=2,
+        store=store, run_id="mp1", verbose=0,
+        backend_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    model = est.fit(df)
+    out = model.transform(df)
+    pred = np.asarray(list(out["prediction"]), np.float32)
+    assert float(np.mean((pred - y[:, 0]) ** 2)) < 0.05
+    assert store.exists(est.checkpoint_path())
+
+
+def test_keras_estimator_multiproc_fit():
+    """num_proc=2 Keras estimator fit: model ships as .keras bytes, each
+    worker re-wraps the optimizer + broadcasts initial weights, rank 0's
+    trained weights return (reference spark/keras/remote.py shape)."""
+    pandas = pytest.importorskip("pandas")
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator
+
+    keras.utils.set_random_seed(0)
+    rng = np.random.RandomState(1)
+    x = rng.randn(128, 3).astype(np.float32)
+    y = (x @ np.ones((3, 1), np.float32))[:, 0]
+    df = pandas.DataFrame({"f": list(x), "y": y})
+    model = keras.Sequential([keras.Input((3,)), keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer=keras.optimizers.Adam(0.05), loss="mse",
+        feature_cols=["f"], label_cols=["y"], batch_size=16, epochs=25,
+        num_proc=2, verbose=0,
+        backend_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    km = est.fit(df)
+    pred = np.asarray(list(km.transform(df)["prediction"]), np.float32)
+    assert float(np.mean((pred - y) ** 2)) < 0.1

@@ -59,3 +59,74 @@ def test_ray_and_spark_examples():
     _run([sys.executable, os.path.join(EXAMPLES, "ray_run.py"),
           "--workers", "2", "--steps", "2"])
     _run([sys.executable, os.path.join(EXAMPLES, "spark_estimator.py")])
+
+
+def test_hvdrun_timeline_end_to_end(tmp_path):
+    """A 2-process hvdrun job with --timeline-filename produces a parseable
+    chrome-trace JSON with negotiation + activity phases (reference
+    test/parallel/test_timeline.py shape)."""
+    import json
+    import textwrap
+
+    tl = os.path.join(str(tmp_path), "timeline.json")
+    script = os.path.join(str(tmp_path), "worker.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            for i in range(3):
+                hvd.synchronize(hvd.allreduce_async(
+                    np.ones(8, np.float32), name=f"tl.t{i}"))
+            hvd.shutdown()
+        """))
+    _run([sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+          "--timeline-filename", tl, "--env", "PALLAS_AXON_POOL_IPS=",
+          sys.executable, script])
+    events = json.load(open(tl))
+    assert isinstance(events, list) and events
+    names = {e.get("name") for e in events}
+    assert any("NEGOTIATE" in (n or "") for n in names), names
+    phases = {e.get("ph") for e in events}
+    assert "B" in phases and "E" in phases
+
+
+def test_keras_estimator_distributed_under_hvdrun(tmp_path):
+    """KerasEstimator.fit inside an hvdrun worker takes the data-parallel
+    branch: wrapped optimizer, sharding, rank-0-only checkpoint."""
+    import textwrap
+
+    store_dir = os.path.join(str(tmp_path), "store")
+    script = os.path.join(str(tmp_path), "worker.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np, keras
+            from horovod_tpu.spark import KerasEstimator, FilesystemStore
+            keras.utils.set_random_seed(0)
+            rng = np.random.RandomState(1)
+            import pandas as pd
+            x = rng.randn(64, 3).astype(np.float32)
+            y = (x @ np.ones((3, 1), np.float32))[:, 0]
+            df = pd.DataFrame({{"f": list(x), "y": y}})
+            model = keras.Sequential([keras.Input((3,)),
+                                      keras.layers.Dense(1)])
+            est = KerasEstimator(model=model,
+                                 optimizer=keras.optimizers.Adam(0.05),
+                                 loss="mse", feature_cols=["f"],
+                                 label_cols=["y"], batch_size=8, epochs=10,
+                                 store=FilesystemStore({store_dir!r}),
+                                 run_id="lk", verbose=0)
+            est.fit(df)
+            assert getattr(model.optimizer.__class__, "_hvd_wrapped", False)
+            print("EST-OK")
+        """))
+    out = _run([sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+                "--env", "PALLAS_AXON_POOL_IPS=",
+                sys.executable, script])
+    assert out.count("EST-OK") == 2
+    assert os.path.exists(os.path.join(store_dir, "runs", "lk",
+                                       "checkpoint"))

@@ -141,3 +141,21 @@ def test_check_build_output(capsys):
     assert "Available Frameworks" in out
     assert "[X] JAX" in out
     assert "Available Tensor Operations" in out
+
+
+def test_launch_local_rank_semantics(tmp_path):
+    """Under the launcher, local_rank/local_size reflect processes on this
+    host (reference gloo_context env consumption), not chips."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import horovod_tpu as hvd
+        hvd.init()
+        assert hvd.local_size() == 2, hvd.local_size()
+        # single-host 2-proc launch: local rank == process rank
+        assert hvd.local_rank() == hvd.cross_rank()
+        print("LR", hvd.local_rank())
+    """))
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
