@@ -20,8 +20,10 @@ Rank/size vocabulary (documented contract):
 - ``rank()``   — global index of this process's first chip. ``rank() == 0``
                  is true exactly on the coordinator process, so rank-0
                  checkpoint/log idioms transfer unchanged.
-- ``local_size()`` / ``local_rank()`` — chips driven by this process / index
-                 of the first one within the host (always 0 for the first).
+- ``local_size()`` / ``local_rank()`` — under a launcher, worker processes
+                 on this host / this worker's index among them (the
+                 launcher-injected HOROVOD_LOCAL_* env wins); standalone,
+                 chips driven by this process / 0.
 - ``cross_size()`` / ``cross_rank()`` — number of processes / this process's
                  index (the reference's cross-communicator,
                  mpi_context.cc:147-156).

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import copy
 import os
-import pickle
 from typing import Callable, Optional
 
 import jax
@@ -104,10 +103,19 @@ class _HostUpdateListener:
 
 
 class ObjectState(State):
-    """Elastic state of picklable attributes (reference ObjectState :116)."""
+    """Elastic state of picklable attributes (reference ObjectState :116).
 
-    def __init__(self, store_path: Optional[str] = None, **kwargs):
+    ``checkpoint_format`` selects the on-disk store layout: "pickle"
+    (single file, default) or "orbax" (tensorstore pytree directory —
+    see utils/checkpoint.py)."""
+
+    def __init__(self, store_path: Optional[str] = None,
+                 checkpoint_format: str = "pickle", **kwargs):
         super().__init__()
+        from ..utils import checkpoint as ckpt
+
+        self._ckpt = ckpt
+        self._ckpt_format = checkpoint_format
         self._store_path = store_path or os.environ.get("HOROVOD_ELASTIC_STORE", "")
         self._saved: dict = {}
         self._attrs = list(kwargs.keys())
@@ -117,9 +125,8 @@ class ObjectState(State):
         # incarnation's commit) wins over the constructor defaults — this is
         # how state survives the TPU restart-based resize (driver.py
         # docstring); never clobber it with fresh defaults here.
-        if self._store_path and os.path.exists(self._store_path):
-            with open(self._store_path, "rb") as f:
-                self._saved = pickle.load(f)
+        if self._store_path and ckpt.exists(self._store_path):
+            self._saved = ckpt.load_pytree(self._store_path)
             self.restore()
         else:
             self.save()
@@ -130,15 +137,13 @@ class ObjectState(State):
     def save(self):
         self._saved = self._snapshot()
         if self._store_path:
-            tmp = self._store_path + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(self._saved, f)
-            os.replace(tmp, self._store_path)
+            self._ckpt.save_pytree(self._store_path, self._saved,
+                                   format=self._ckpt_format)
 
     def restore(self):
-        if not self._saved and self._store_path and os.path.exists(self._store_path):
-            with open(self._store_path, "rb") as f:
-                self._saved = pickle.load(f)
+        if not self._saved and self._store_path and \
+                self._ckpt.exists(self._store_path):
+            self._saved = self._ckpt.load_pytree(self._store_path)
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
 

@@ -1,3 +1,4 @@
 from .mlp import MLP, MnistConvNet  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .vit import ViT, ViT_B16, ViT_L16, ViT_S16  # noqa: F401
 from . import transformer  # noqa: F401

@@ -1,0 +1,92 @@
+"""Vision Transformer (ViT) — second vision family next to ResNet.
+
+Greenfield relative to the reference (Horovod is model-agnostic; its
+benchmarks use CNN families, docs/benchmarks.rst), included so the
+framework's model zoo covers both conv and attention vision workloads.
+TPU-shaped: bfloat16 compute, patchify as one big matmul (MXU-friendly),
+flax module mirroring `models/resnet.py` conventions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    n_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads, dtype=self.dtype)(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        return x + MlpBlock(self.mlp_dim, self.dtype)(y)
+
+
+class ViT(nn.Module):
+    """ViT-style classifier over square images (NHWC)."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        b, h, w, c = images.shape
+        p = self.patch_size
+        x = images.astype(self.dtype)
+        # patchify → one big matmul (conv with stride=kernel=p)
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p), dtype=self.dtype,
+                    name="embedding")(x)
+        x = x.reshape(b, -1, self.d_model)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(self.dtype),
+             x], axis=1)
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.d_model))
+        x = x + pos.astype(self.dtype)
+        for i in range(self.n_layers):
+            x = EncoderBlock(self.n_heads, self.mlp_dim, self.dtype,
+                             name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+def ViT_S16(**kw) -> ViT:
+    return ViT(patch_size=16, d_model=384, n_layers=12, n_heads=6,
+               mlp_dim=1536, **kw)
+
+
+def ViT_B16(**kw) -> ViT:
+    return ViT(patch_size=16, d_model=768, n_layers=12, n_heads=12,
+               mlp_dim=3072, **kw)
+
+
+def ViT_L16(**kw) -> ViT:
+    return ViT(patch_size=16, d_model=1024, n_layers=24, n_heads=16,
+               mlp_dim=4096, **kw)

@@ -45,8 +45,55 @@ def top1_gating(gate_logits, n_experts: int, capacity: int):
     return dispatch, combine, aux
 
 
+def topk_gating(gate_logits, n_experts: int, capacity: int, k: int = 2,
+                normalize: bool = True):
+    """GShard-style top-k gating with per-expert capacity.
+
+    Picks experts greedily (k rounds of masked argmax); each pick's queue
+    position accounts for slots consumed by earlier picks. With
+    ``normalize`` the k gate values are renormalized to sum to 1 per
+    token (GShard top-2 convention). Returns (dispatch [t,e,c],
+    combine [t,e,c], aux_loss) like ``top1_gating``.
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    t = probs.shape[0]
+    remaining = probs
+    used = jnp.zeros((1, n_experts), jnp.float32)
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    gates_raw = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    first_onehot = None
+    for _ in range(k):
+        expert = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+        if first_onehot is None:
+            first_onehot = onehot
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0 + used * onehot
+        in_cap = (pos < capacity) & (pos >= 0) & (onehot > 0)
+        pos = jnp.where(in_cap, pos, 0.0)
+        cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                    dtype=jnp.float32) * in_cap[..., None]
+        d_i = onehot[..., None] * cap_onehot
+        dispatch = dispatch + d_i
+        gates_raw = gates_raw + d_i * gate[:, None, None]
+        used = used + jnp.sum(onehot, axis=0, keepdims=True)
+        remaining = remaining * (1.0 - onehot)
+    if normalize:
+        # renormalize over the *dispatched* picks only (GShard top-2)
+        denom = jnp.sum(gates_raw, axis=(1, 2), keepdims=True)
+        combine = gates_raw / jnp.maximum(denom, 1e-9)
+    else:
+        combine = gates_raw
+    # load-balancing aux on the first pick (Switch eq. 4 over top-1 routes)
+    density = jnp.mean(first_onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * n_experts
+    return dispatch, combine, aux
+
+
 def moe_layer(x, gate_w, expert_fn: Callable, expert_params, *,
-              axis_name: str = "ep", capacity_factor: float = 1.25):
+              axis_name: str = "ep", capacity_factor: float = 1.25,
+              k: int = 1):
     """Expert-parallel MoE layer (per-chip view inside shard_map).
 
     Args:
@@ -68,7 +115,11 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params, *,
     capacity = max(1, int(capacity_factor * t_local / n_experts))
 
     gate_logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    dispatch, combine, aux = top1_gating(gate_logits, n_experts, capacity)
+    if k <= 1:
+        dispatch, combine, aux = top1_gating(gate_logits, n_experts, capacity)
+    else:
+        dispatch, combine, aux = topk_gating(gate_logits, n_experts,
+                                             capacity, k=k)
 
     # gather expert inputs: [e, c, d] then alltoall over experts' owner axis
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))

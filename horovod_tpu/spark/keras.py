@@ -119,10 +119,26 @@ class KerasEstimator:
         if distributed:
             if not getattr(self.model.optimizer.__class__, "_hvd_wrapped",
                            False):
+                # keep the model's own compiled metrics when the estimator
+                # didn't specify any (re-compiling with [] would silently
+                # drop e.g. accuracy from a user-pre-compiled model)
+                metrics = self.metrics
+                if not metrics:
+                    try:
+                        cfg = self.model.get_compile_config() or {}
+                        m = cfg.get("metrics")
+                        if m:
+                            import keras
+
+                            metrics = [keras.metrics.deserialize(e)
+                                       if isinstance(e, dict) else e
+                                       for e in m]
+                    except Exception:
+                        metrics = None
                 self.model.compile(
                     optimizer=hvd_keras.DistributedOptimizer(
                         self.model.optimizer),
-                    loss=self.model.loss, metrics=self.metrics)
+                    loss=self.model.loss, metrics=metrics or None)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             x, y = x[r::n], y[r::n]
             callbacks = [

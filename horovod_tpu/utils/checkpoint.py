@@ -1,0 +1,93 @@
+"""Checkpoint helpers: pickle (default) or Orbax pytree format.
+
+Reference analogue (SURVEY.md §5.4): the reference has no checkpoint
+format of its own — rank-0-writes + broadcast, elastic State snapshots,
+and the Spark Store. The TPU-native addition here is an Orbax-backed
+pytree format (`orbax.checkpoint` is the standard JAX checkpoint layer):
+elastic `JaxState` and user training loops can persist params/opt-state
+trees in a format that interoperates with the wider JAX ecosystem and
+scales to sharded multi-host arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+
+def have_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _rm(path: str):
+    import shutil
+
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def save_pytree(path: str, tree: Any, *, format: str = "pickle"):
+    """Crash-safe persist of a pytree. ``format``: "pickle" (single file)
+    or "orbax" (directory; arrays in Orbax's tensorstore layout).
+
+    Orbax directories cannot be atomically replaced the way a file can
+    (``os.replace`` refuses non-empty dst dirs), so the sequence is
+    write-tmp → rotate current to ``path + ".old"`` → rename tmp into
+    place → drop the rotation. A crash in the middle leaves either the
+    new tmp or the ``.old`` rotation on disk, and ``load_pytree``/
+    ``exists`` fall back to ``.old`` — committed state is never lost.
+    """
+    if format == "orbax":
+        import orbax.checkpoint as ocp
+
+        tmp, old = path + ".tmp_ckpt", path + ".old"
+        _rm(tmp)
+        ocp.PyTreeCheckpointer().save(tmp, tree)
+        _rm(old)
+        if os.path.exists(path):
+            os.rename(path, old)
+        os.rename(tmp, path)
+        _rm(old)
+        return
+    if format != "pickle":
+        raise ValueError(f"unknown checkpoint format {format!r}")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(tree, f)
+    os.replace(tmp, path)
+
+
+def _resolve(path: str) -> str:
+    """The live checkpoint path: ``path`` itself, or its ``.old`` rotation
+    left by a crash mid-save."""
+    if os.path.exists(path):
+        return path
+    if os.path.exists(path + ".old"):
+        return path + ".old"
+    return path
+
+
+def load_pytree(path: str, *, format: Optional[str] = None) -> Any:
+    """Load a checkpoint written by ``save_pytree``. ``format=None``
+    auto-detects: a directory is Orbax, a file is pickle."""
+    path = _resolve(path)
+    if format is None:
+        format = "orbax" if os.path.isdir(path) else "pickle"
+    if format == "orbax":
+        import orbax.checkpoint as ocp
+
+        return ocp.PyTreeCheckpointer().restore(path)
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def exists(path: str) -> bool:
+    return os.path.exists(path) or os.path.exists(path + ".old")

@@ -241,3 +241,25 @@ def test_bench_resnet_scan_equivalence():
     ips2 = bench.bench_resnet(2, warmup=1, iters=1, scan_steps=2,
                               model_fn=tiny, image_size=32, num_classes=10)
     assert ips1 > 0 and ips2 > 0
+
+
+def test_checkpoint_format_transition_and_crash_rotation(tmp_path):
+    """save_pytree survives format switches (pickle file → orbax dir) and
+    a crash-interrupted orbax save leaves the .old rotation loadable."""
+    import os
+
+    import numpy as np
+
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    p = str(tmp_path / "ck")
+    ckpt.save_pytree(p, {"a": 1}, format="pickle")
+    if ckpt.have_orbax():
+        # switching formats over an existing pickle file must not crash
+        ckpt.save_pytree(p, {"a": np.arange(3.0)}, format="orbax")
+        assert os.path.isdir(p)
+        np.testing.assert_allclose(ckpt.load_pytree(p)["a"], np.arange(3.0))
+        # simulate a crash between rotation and rename: only .old exists
+        os.rename(p, p + ".old")
+        assert ckpt.exists(p)
+        np.testing.assert_allclose(ckpt.load_pytree(p)["a"], np.arange(3.0))

@@ -83,3 +83,29 @@ def test_graft_entry_dryrun():
     fn, args = g.entry()
     out = jax.eval_shape(jax.jit(fn), *args)
     assert out.shape[-1] == 1000
+
+
+def test_vit_forward_and_grad():
+    """ViT family: forward shape + trainable loss gradient (bf16 compute,
+    f32 head — same conventions as ResNet)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import ViT
+
+    model = ViT(num_classes=10, patch_size=4, d_model=32, n_layers=2,
+                n_heads=4, mlp_dim=64)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 16, 3),
+                    jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+
+    def loss(p):
+        return jnp.mean(jax.nn.log_softmax(model.apply(p, x)) ** 2)
+
+    g = jax.grad(lambda p: loss(p))(params)
+    leaves = jax.tree.leaves(g)
+    assert leaves and all(np.all(np.isfinite(np.asarray(l, np.float32)))
+                          for l in leaves)
