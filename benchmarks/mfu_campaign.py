@@ -1,0 +1,75 @@
+"""MFU campaign: run on the real chip when available.
+
+Sweeps per-chip batch × scan-steps on the full training step, plus the
+microbenchmark peaks (matmul / conv / no-BN forward) from ablate_mfu2.
+Writes one JSON line per configuration to benchmarks/mfu_results.jsonl
+(append), so partial progress survives interruptions.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mfu_results.jsonl")
+
+
+def record(**kw):
+    kw["ts"] = time.time()
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    import horovod_tpu as hvd
+    from bench import (RESNET50_FWD_FLOP_PER_IMG as FWD,
+                       TRAIN_FLOP_MULT, bench_resnet, chip_peak_flops)
+
+    hvd.init()
+    PEAK = chip_peak_flops()
+    record(event="start", device=jax.devices()[0].device_kind)
+
+    # 1. pure matmul peak — what can this chip/tunnel deliver at all?
+    n = 4096
+    a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+    b = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    for _ in range(3):
+        out = f(a, b)
+    float(jnp.asarray(out).ravel()[0])
+    t0 = time.perf_counter()
+    iters = 50
+    for _ in range(iters):
+        out = f(a, b)
+    float(jnp.asarray(out).ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    record(event="matmul4096", ms=dt * 1e3, tflops=2 * n ** 3 / dt / 1e12,
+           mfu=2 * n ** 3 / dt / PEAK)
+
+    # 2. batch × scan sweep on the real training step
+    for batch in (256, 512):
+        for scan in (1, 4, 8):
+            try:
+                ips = bench_resnet(batch, warmup=2, iters=4,
+                                   scan_steps=scan)
+                record(event="resnet", batch=batch, scan=scan,
+                       img_s=round(ips, 1),
+                       mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
+            except Exception as e:
+                msg = f"{type(e).__name__}: {e}"
+                record(event="resnet_error", batch=batch, scan=scan,
+                       error=msg[:200])
+                if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                    break  # OOM: larger scan won't help at this batch
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Tunnel-recovery watcher: probes the TPU; on recovery runs the MFU
+# campaign once. Log: benchmarks/watch.log
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 90 python -c "import jax, jax.numpy as jnp; float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
+    echo "TUNNEL-HEALED attempt $i $(date +%H:%M:%S)"
+    timeout 3000 python benchmarks/mfu_campaign.py 2>&1 | grep -v WARNING
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -eq 0 ]; then
+      echo "CAMPAIGN-DONE $(date +%H:%M:%S)"
+      exit 0
+    fi
+    echo "CAMPAIGN-FAILED rc=$rc $(date +%H:%M:%S); will retry"
+    # keep probing: a transient tunnel error should not end the watcher
+  fi
+  echo "probe $i down $(date +%H:%M:%S)"
+  sleep 180
+done
+echo "WATCHER-EXPIRED $(date +%H:%M:%S)"

@@ -36,6 +36,7 @@ def main():
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--use-adasum", action="store_true")
     p.add_argument("--fp16-allreduce", action="store_true")
+    # (sampler-based loading also demonstrates hvd.ElasticSampler)
     args = p.parse_args()
 
     hvd.init()
@@ -44,10 +45,13 @@ def main():
     rng = np.random.RandomState(0)
     x = torch.tensor(rng.rand(2048, 1, 28, 28), dtype=torch.float32)
     y = torch.tensor((rng.rand(2048) * 10), dtype=torch.long) % 10
-    # per-process shard (reference: DistributedSampler(num_replicas=size,
-    # rank=rank))
-    x = x[hvd.cross_rank()::hvd.cross_size()]
-    y = y[hvd.cross_rank()::hvd.cross_size()]
+    # elastic-aware per-process sharding (reference ElasticSampler /
+    # DistributedSampler): shards by process, tracks processed indices so
+    # an elastic reset mid-epoch does not repeat data
+    dataset = torch.utils.data.TensorDataset(x, y)
+    sampler = hvd.ElasticSampler(dataset, shuffle=True)
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
 
     model = Net()
     # linear LR scaling by the number of gradient contributors: the eager
@@ -67,14 +71,14 @@ def main():
 
     for epoch in range(args.epochs):
         model.train()
-        perm = torch.randperm(len(x))
+        sampler.set_epoch(epoch)
         loss = None
-        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
-            idx = perm[i:i + args.batch_size]
+        for batch_idx, (bx, by) in enumerate(loader):
             optimizer.zero_grad()
-            loss = F.nll_loss(model(x[idx]), y[idx])
+            loss = F.nll_loss(model(bx), by)
             loss.backward()
             optimizer.step()
+            sampler.record_batch(batch_idx, args.batch_size)
         if hvd.rank() == 0 and loss is not None:
             print(f"epoch {epoch}: loss={float(loss):.4f}")
 
