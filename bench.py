@@ -23,6 +23,7 @@ Prints ONE JSON line:
 """
 
 import json
+import os
 import sys
 import time
 
@@ -216,8 +217,26 @@ def bench_moe_alltoall(tokens_per_chip: int = 2048, d_model: int = 512,
 def main():
     hvd.init()
     quick = "--quick" in sys.argv  # CPU/CI smoke: tiny sizes
-    per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else 256)
-    scan_steps = _sync_int_env("HVD_BENCH_SCAN_STEPS", 1 if quick else 4)
+    # defaults come from the last MFU campaign on this machine when
+    # available (benchmarks/mfu_campaign.py writes the winning config);
+    # env vars always win
+    tuned_batch, tuned_scan = 256, 4
+    # per-machine file: only honored in single-process runs — multi-host
+    # ranks could read different local files and submit mismatched
+    # collective shapes (env vars are launcher-propagated, so they stay
+    # the cross-process path)
+    if hvd.cross_size() <= 1:
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "benchmarks", "bench_tuned.json")) as f:
+                tuned = json.load(f)
+            tuned_batch = int(tuned.get("batch", tuned_batch))
+            tuned_scan = int(tuned.get("scan_steps", tuned_scan))
+        except Exception:
+            pass
+    per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else tuned_batch)
+    scan_steps = _sync_int_env("HVD_BENCH_SCAN_STEPS",
+                               1 if quick else tuned_scan)
     per_chip_ips = bench_resnet(per_chip, warmup=2 if quick else 5,
                                 iters=3 if quick else 8,
                                 scan_steps=scan_steps)
@@ -256,8 +275,6 @@ def main():
 
 
 def _sync_int_env(name, default):
-    import os
-
     try:
         return int(os.environ.get(name, default))
     except ValueError:

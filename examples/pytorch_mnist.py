@@ -45,9 +45,10 @@ def main():
     rng = np.random.RandomState(0)
     x = torch.tensor(rng.rand(2048, 1, 28, 28), dtype=torch.float32)
     y = torch.tensor((rng.rand(2048) * 10), dtype=torch.long) % 10
-    # elastic-aware per-process sharding (reference ElasticSampler /
-    # DistributedSampler): shards by process, tracks processed indices so
-    # an elastic reset mid-epoch does not repeat data
+    # per-process sharding via ElasticSampler (reference ElasticSampler /
+    # DistributedSampler). The record_batch tracking becomes load-bearing
+    # when the sampler is registered with hvd.elastic TorchState(sampler=)
+    # in an elastic run; here it demonstrates the API
     dataset = torch.utils.data.TensorDataset(x, y)
     sampler = hvd.ElasticSampler(dataset, shuffle=True)
     loader = torch.utils.data.DataLoader(
