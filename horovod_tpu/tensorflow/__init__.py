@@ -173,25 +173,94 @@ def grouped_allreduce(tensors, average=None, device_dense="",
 
 def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
-    h = _core.allgather_async(_to_np(tensor), name, process_set=process_set)
-    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+    """Differentiable allgather (reference mpi_ops.py:212 gradient
+    registration: allreduce-average the cotangent, then take this
+    worker's slice)."""
+    # duck-typed rank/rows: tf.TensorShape has .rank, numpy/list shapes
+    # are plain tuples (both are valid inputs via _to_np)
+    shp = getattr(tensor, "shape", None)
+    if shp is None:
+        shp = np.asarray(tensor).shape
+    nrank = getattr(shp, "rank", None)
+    if nrank is None:
+        nrank = len(shp)
+    local_rows = int(shp[0]) if nrank else 0
+
+    @tf.custom_gradient
+    def _op(t_in):
+        h = _core.allgather_async(_to_np(t_in), name,
+                                  process_set=process_set)
+        out = _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+
+        def grad(dy):
+            red = allreduce(dy, average=True, process_set=process_set,
+                            name=f"{name}.grad" if name else None)
+            r = (process_set or global_process_set()).cross_rank
+            # every worker contributed local_rows rows in rank order
+            # (ragged inputs gather their own row counts the same way)
+            sizes = _core.synchronize(_core.allgather_async(
+                np.asarray([local_rows]),
+                f"{name or 'allgather'}.grad.sizes",
+                process_set=process_set))
+            start = int(np.sum(np.asarray(sizes)[:r]))
+            return red[start:start + local_rows]
+
+        return out, grad
+
+    return _op(tensor)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
-    h = _core.broadcast_async(_to_np(tensor), root_rank, name,
-                              process_set=process_set)
-    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+    """Differentiable broadcast (reference mpi_ops.py:257 gradient:
+    allreduce-average the cotangent; non-root workers get zeros)."""
+
+    @tf.custom_gradient
+    def _op(t_in):
+        h = _core.broadcast_async(_to_np(t_in), root_rank, name,
+                                  process_set=process_set)
+        out = _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+
+        def grad(dy):
+            red = allreduce(dy, average=True, process_set=process_set,
+                            name=f"{name}.grad" if name else None)
+            # root_rank is a *chip* index in the process set (core
+            # broadcast semantics, ops/collectives.py); the gradient
+            # belongs to the process that owns that chip
+            import jax
+
+            ps = process_set or global_process_set()
+            is_root = (ps.devices[root_rank].process_index
+                       == jax.process_index())
+            return red if is_root else red * 0
+
+        return out, grad
+
+    return _op(tensor)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set: Optional[ProcessSet] = None):
-    h = _core.alltoall_async(_to_np(tensor),
-                             None if splits is None else _to_np(splits),
-                             name, process_set=process_set)
-    out, recv = _core.synchronize(h)
-    return (_from_np(out, tf.as_dtype(tensor.dtype)),
-            tf.constant(np.asarray(recv), dtype=tf.int32))
+    """Differentiable alltoall (reference mpi_ops.py:314 gradient: the
+    cotangent routes back with splits = received_splits)."""
+    @tf.custom_gradient
+    def _op(t_in):
+        h = _core.alltoall_async(_to_np(t_in),
+                                 None if splits is None else _to_np(splits),
+                                 name, process_set=process_set)
+        out, recv = _core.synchronize(h)
+        recv = np.asarray(recv)
+
+        def grad(dy, _drecv=None):
+            back, _ = alltoall(dy, splits=recv,
+                               name=f"{name}.grad" if name else None,
+                               process_set=process_set)
+            return back
+
+        return (_from_np(out, tf.as_dtype(t_in.dtype)),
+                tf.constant(recv, dtype=tf.int32)), grad
+
+    return _op(tensor)
 
 
 def reducescatter(tensor, op=None, name: Optional[str] = None,

@@ -247,3 +247,28 @@ def test_distributed_adasum_optimizer_inside_tf_function():
     # commit ran on even steps: snapshot tracks the committed weights
     (start_var,) = opt._start.values()
     np.testing.assert_allclose(start_var.numpy(), w.numpy(), rtol=1e-5)
+
+
+def test_allgather_broadcast_alltoall_gradients():
+    """Gradient registrations (reference mpi_ops.py:212/:257/:314): at
+    size=1 allgather grad == identity slice, broadcast grad on root ==
+    average, alltoall grad routes back."""
+    x = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+
+    with tf.GradientTape() as tape:
+        g = hvd.allgather(x, name="tf.grad.ag")
+        loss = tf.reduce_sum(g * g)
+    dx = tape.gradient(loss, x)
+    np.testing.assert_allclose(dx.numpy(), 2 * x.numpy())  # d/dx sum(x^2)
+
+    with tf.GradientTape() as tape:
+        b = hvd.broadcast(x, root_rank=0, name="tf.grad.bc")
+        loss = tf.reduce_sum(3.0 * b)
+    dx = tape.gradient(loss, x)
+    np.testing.assert_allclose(dx.numpy(), np.full((2, 2), 3.0))
+
+    with tf.GradientTape() as tape:
+        out, recv = hvd.alltoall(x, splits=[2], name="tf.grad.a2a")
+        loss = tf.reduce_sum(out * tf.constant([[1.0, 2.0], [3.0, 4.0]]))
+    dx = tape.gradient(loss, x)
+    np.testing.assert_allclose(dx.numpy(), [[1.0, 2.0], [3.0, 4.0]])
