@@ -1,0 +1,74 @@
+"""PyTorch synthetic benchmark over the eager shim (reference
+examples/pytorch/pytorch_synthetic_benchmark.py shape: synthetic batches,
+DistributedOptimizer, img/sec per worker + total with stddev).
+
+Run:  hvdrun -np 2 python examples/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234)
+
+    # small conv net standing in for the reference's torchvision model
+    # (no torchvision download in zero-egress environments)
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 32, 3, stride=2, padding=1), torch.nn.ReLU(),
+        torch.nn.Conv2d(32, 64, 3, stride=2, padding=1), torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(64, 10))
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.cross_size())
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 64, 64)
+    target = torch.randint(0, 10, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        img_sec = args.batch_size * args.num_batches_per_iter / (time.time() - t0)
+        img_secs.append(img_sec)
+
+    img_sec_mean, img_sec_conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    if hvd.rank() == 0:
+        n = hvd.cross_size()
+        print(f"Img/sec per worker: {img_sec_mean:.1f} +- {img_sec_conf:.1f}")
+        print(f"Total img/sec on {n} worker(s): "
+              f"{n * img_sec_mean:.1f} +- {n * img_sec_conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""TF2 synthetic benchmark over the eager shim (reference
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py shape: synthetic
+batches, DistributedGradientTape, img/sec per worker + total).
+
+Run:  hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.Conv2D(64, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.cross_size())
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    data = tf.random.uniform([args.batch_size, 64, 64, 3])
+    target = tf.random.uniform([args.batch_size], maxval=10, dtype=tf.int64)
+
+    first = {"done": False}
+
+    def benchmark_step():
+        with tf.GradientTape() as tape:
+            loss = loss_fn(target, model(data, training=True))
+        tape = hvd.DistributedGradientTape(tape, compression=compression)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if not first["done"]:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            first["done"] = True
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        img_secs.append(args.batch_size * args.num_batches_per_iter
+                        / (time.time() - t0))
+
+    img_sec_mean, img_sec_conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    if hvd.rank() == 0:
+        n = hvd.cross_size()
+        print(f"Img/sec per worker: {img_sec_mean:.1f} +- {img_sec_conf:.1f}")
+        print(f"Total img/sec on {n} worker(s): "
+              f"{n * img_sec_mean:.1f} +- {n * img_sec_conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()

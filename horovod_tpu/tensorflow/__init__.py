@@ -176,33 +176,33 @@ def allgather(tensor, name: Optional[str] = None,
     """Differentiable allgather (reference mpi_ops.py:212 gradient
     registration: allreduce-average the cotangent, then take this
     worker's slice)."""
-    # duck-typed rank/rows: tf.TensorShape has .rank, numpy/list shapes
-    # are plain tuples (both are valid inputs via _to_np)
-    shp = getattr(tensor, "shape", None)
-    if shp is None:
-        shp = np.asarray(tensor).shape
-    nrank = getattr(shp, "rank", None)
-    if nrank is None:
-        nrank = len(shp)
-    local_rows = int(shp[0]) if nrank else 0
 
     @tf.custom_gradient
     def _op(t_in):
-        h = _core.allgather_async(_to_np(t_in), name,
-                                  process_set=process_set)
+        arr = _to_np(t_in)
+        local_rows = int(arr.shape[0]) if arr.ndim else 0
+        h = _core.allgather_async(arr, name, process_set=process_set)
         out = _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+        start_cache: list[int] = []  # memoized (persistent tapes)
 
         def grad(dy):
             red = allreduce(dy, average=True, process_set=process_set,
                             name=f"{name}.grad" if name else None)
-            r = (process_set or global_process_set()).cross_rank
-            # every worker contributed local_rows rows in rank order
-            # (ragged inputs gather their own row counts the same way)
-            sizes = _core.synchronize(_core.allgather_async(
-                np.asarray([local_rows]),
-                f"{name or 'allgather'}.grad.sizes",
-                process_set=process_set))
-            start = int(np.sum(np.asarray(sizes)[:r]))
+            ps = process_set or global_process_set()
+            if not start_cache:
+                if ps.cross_size <= 1:
+                    start_cache.append(0)
+                else:
+                    # workers contributed rows in rank order; ragged
+                    # inputs need everyone's row counts (one exchange,
+                    # backward-only, memoized)
+                    sizes = _core.synchronize(_core.allgather_async(
+                        np.asarray([local_rows]),
+                        f"{name or 'allgather'}.grad.sizes",
+                        process_set=process_set))
+                    start_cache.append(
+                        int(np.sum(np.asarray(sizes)[:ps.cross_rank])))
+            start = start_cache[0]
             return red[start:start + local_rows]
 
         return out, grad
