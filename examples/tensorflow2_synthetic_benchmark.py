@@ -50,7 +50,11 @@ def main():
         grads = tape.gradient(loss, model.trainable_variables)
         opt.apply_gradients(zip(grads, model.trainable_variables))
         if not first["done"]:
+            # both model weights AND optimizer slots: stateful optimizers
+            # (momentum/Adam) would otherwise keep per-worker slot values
+            # seeded from divergent pre-broadcast gradients
             hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
             first["done"] = True
 
     for _ in range(args.num_warmup_batches):

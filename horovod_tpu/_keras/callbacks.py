@@ -114,3 +114,50 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         e = epoch if self.staircase else epoch  # per-epoch granularity
         self.model.optimizer.learning_rate.assign(
             self.initial_lr * self.multiplier(e))
+
+
+class CommitStateCallback(keras.callbacks.Callback):
+    """Commit elastic state every ``batches_per_commit`` batches from a
+    ``model.fit`` loop, plus at every epoch end (reference keras elastic
+    CommitStateCallbackImpl: the end-of-epoch state — batch reset, epoch
+    advanced — must be durable, and the batch counter resets at train
+    begin so restarted workers commit on the same boundaries)."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = int(batches_per_commit)
+        self._i = 0
+
+    def on_train_begin(self, logs=None):
+        self._i = 0
+
+    def on_batch_end(self, batch, logs=None):
+        self._i += 1
+        if self._i % self.batches_per_commit == 0:
+            self.state.commit()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.commit()
+
+
+class UpdateBatchStateCallback(keras.callbacks.Callback):
+    """Track batch/epoch progress in elastic state (reference keras
+    elastic UpdateBatchStateCallback). Keras 3's fit loop cannot skip
+    already-processed batches from a callback (the reference shrank
+    ``params['steps']``, a Keras-2 mechanism), so a resumed worker
+    restarts its epoch; ``state.batch`` remains available for users who
+    shard their dataset to continue mid-epoch."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch + 1
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0

@@ -119,3 +119,30 @@ def test_backward_passes_per_step_inside_model_fit():
     assert hist.history["loss"][-1] < hist.history["loss"][0]
     # 6 epochs x 4 batches = 24 calls → 12 real optimizer steps
     assert int(opt.iterations.numpy()) == 12
+
+
+def test_keras_elastic_callbacks_commit_and_track():
+    """Keras-API elastic callbacks (reference keras elastic
+    CommitStateCallback/UpdateBatchStateCallback): periodic commits and
+    batch/epoch tracking from a real model.fit loop."""
+    import keras
+    import numpy as np
+
+    from horovod_tpu.elastic import ObjectState
+
+    commits = []
+    state = ObjectState(epoch=0, batch=0)
+    orig_commit = state.commit
+    state.commit = lambda: (commits.append(1), orig_commit())[1]
+
+    keras.utils.set_random_seed(0)
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = x @ np.ones((4, 1), np.float32)
+    model = keras.Sequential([keras.Input((4,)), keras.layers.Dense(1)])
+    model.compile(optimizer="sgd", loss="mse")
+    cbs = [hvd.callbacks.CommitStateCallback(state, batches_per_commit=2),
+           hvd.callbacks.UpdateBatchStateCallback(state)]
+    model.fit(x, y, batch_size=8, epochs=2, callbacks=cbs, verbose=0)
+    # 2 epochs x 4 batches -> 4 periodic commits + 2 epoch-end commits
+    assert len(commits) == 6
+    assert state.epoch == 1 and state.batch == 0  # reset at epoch end
