@@ -134,7 +134,8 @@ class CommitStateCallback(keras.callbacks.Callback):
 
     def on_batch_end(self, batch, logs=None):
         self._i += 1
-        if self._i % self.batches_per_commit == 0:
+        if self.batches_per_commit > 0 and \
+                self._i % self.batches_per_commit == 0:
             self.state.commit()
 
     def on_epoch_end(self, epoch, logs=None):
@@ -147,7 +148,11 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
     already-processed batches from a callback (the reference shrank
     ``params['steps']``, a Keras-2 mechanism), so a resumed worker
     restarts its epoch; ``state.batch`` remains available for users who
-    shard their dataset to continue mid-epoch."""
+    shard their dataset to continue mid-epoch.
+
+    Order this callback BEFORE CommitStateCallback in the callbacks list
+    (Keras invokes callbacks in order) so commits persist the updated
+    counters rather than the previous batch's."""
 
     def __init__(self, state):
         super().__init__()
@@ -160,4 +165,7 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
         self.state.epoch = epoch
 
     def on_epoch_end(self, epoch, logs=None):
+        # the durable epoch-boundary snapshot is "next epoch, batch 0" —
+        # a worker restored from it must not repeat the completed epoch
         self.state.batch = 0
+        self.state.epoch = epoch + 1

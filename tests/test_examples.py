@@ -21,8 +21,11 @@ def _env():
     return e
 
 
-def _run(argv, timeout=420):
-    p = subprocess.run(argv, env=_env(), cwd=REPO, capture_output=True,
+def _run(argv, timeout=420, env_extra=None):
+    env = _env()
+    if env_extra:
+        env.update(env_extra)
+    p = subprocess.run(argv, env=env, cwd=REPO, capture_output=True,
                        text=True, timeout=timeout)
     assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
     return p.stdout
@@ -194,3 +197,14 @@ def test_tf_collective_gradients_two_proc(tmp_path):
                 "--env", "PALLAS_AXON_POOL_IPS=",
                 sys.executable, script])
     assert out.count("GRAD-OK") == 2
+
+
+def test_elastic_and_moe_examples():
+    """Remaining examples as smoke: elastic_jax single-process (plain-loop
+    degeneration) and the MoE alltoall benchmark on the 8-dev CPU mesh."""
+    mesh8 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    _run([sys.executable, os.path.join(EXAMPLES, "elastic_jax.py"),
+          "--epochs", "1", "--batch", "64"], env_extra=mesh8)
+    _run([sys.executable, os.path.join(EXAMPLES, "moe_alltoall_benchmark.py"),
+          "--tokens-per-chip", "64", "--d-model", "32", "--exchange-mb",
+          "1"], env_extra=mesh8)

@@ -140,9 +140,14 @@ def test_keras_elastic_callbacks_commit_and_track():
     y = x @ np.ones((4, 1), np.float32)
     model = keras.Sequential([keras.Input((4,)), keras.layers.Dense(1)])
     model.compile(optimizer="sgd", loss="mse")
-    cbs = [hvd.callbacks.CommitStateCallback(state, batches_per_commit=2),
-           hvd.callbacks.UpdateBatchStateCallback(state)]
+    # Update BEFORE Commit: commits must persist updated counters
+    cbs = [hvd.callbacks.UpdateBatchStateCallback(state),
+           hvd.callbacks.CommitStateCallback(state, batches_per_commit=2)]
     model.fit(x, y, batch_size=8, epochs=2, callbacks=cbs, verbose=0)
     # 2 epochs x 4 batches -> 4 periodic commits + 2 epoch-end commits
     assert len(commits) == 6
-    assert state.epoch == 1 and state.batch == 0  # reset at epoch end
+    # durable snapshot is "next epoch, batch 0": restore must not repeat
+    # the completed epoch
+    state.batch = 99
+    state.restore()
+    assert state.epoch == 2 and state.batch == 0
