@@ -29,6 +29,7 @@ HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_RESPONSE_TIMEOUT_S = "HOROVOD_RESPONSE_TIMEOUT_S"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
@@ -110,6 +111,10 @@ class RuntimeConfig:
     stall_check_disable: bool = False
     stall_warning_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0
+    # how long a worker blocks on a negotiation-round response before
+    # declaring the controller dead (coordinator failures error-close the
+    # round proactively, so this is a backstop, not the common path)
+    response_timeout_s: float = 300.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     elastic: bool = False
@@ -137,6 +142,8 @@ class RuntimeConfig:
         c.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
         c.stall_warning_time_s = get_float(HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0)
         c.stall_shutdown_time_s = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)
+        c.response_timeout_s = get_float(HOROVOD_RESPONSE_TIMEOUT_S,
+                                         c.response_timeout_s)
         c.hierarchical_allreduce = get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE)
         c.hierarchical_allgather = get_bool(HOROVOD_HIERARCHICAL_ALLGATHER)
         c.elastic = get_bool(HOROVOD_ELASTIC)

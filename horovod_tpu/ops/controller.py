@@ -153,6 +153,11 @@ class KVController:
         except Exception:
             self.broken = True
             raise
+        if resp.get("abort"):
+            # coordinator died and fail-fast-closed the round: this
+            # controller can never rejoin the lockstep
+            self.broken = True
+            raise RuntimeError(resp["abort"])
         self.round += 1
         if resp.get("invalidate"):
             # coordinator dropped its submission cache (error-closed
@@ -273,8 +278,10 @@ class _Coordinator(threading.Thread):
 
     def run(self):
         r = 0
+        resp_published = False
         while not self._stop_evt.is_set():
             try:
+                resp_published = False
                 got = self._gather_round(r)
                 if got is None:
                     if self._stop_evt.is_set():
@@ -328,6 +335,7 @@ class _Coordinator(threading.Thread):
                                             "sigs": sigs,
                                             "errors": errors,
                                             "join_done": join_done}).encode())
+                resp_published = True
                 if r >= 2:
                     self.client.delete_scope(f"ctl/r{r - 2}")
                 r += 1
@@ -335,7 +343,24 @@ class _Coordinator(threading.Thread):
                 if self._stop_evt.is_set():
                     return
                 LOG.warning("coordinator round %d error: %s", r, e)
+                self._abort_close(r + 1 if resp_published else r, e)
                 return
+
+    def _abort_close(self, r: int, exc: Exception):
+        """Fail-fast on coordinator death (reference operations.cc:587 —
+        an aborting background loop fails every pending entry instead of
+        leaving workers to time out). Publish an abort response for the
+        round workers are (or will next be) blocked on: round r if its
+        response was not yet published, else round r+1."""
+        msg = (f"coordinator aborted in negotiation round: {exc!r}; "
+               "pending collectives failed (re-initialize horovod_tpu)")
+        errors = {n: msg for n in self.order}
+        payload = json.dumps({"ready": [], "errors": errors,
+                              "abort": msg, "invalidate": True}).encode()
+        try:
+            self.client.put(f"ctl/r{r}", "resp", payload)
+        except Exception:
+            pass  # store unreachable: workers fall back to their timeout
 
     def _check_stalled_tensors(self):
         """Per-tensor stall attribution after a completed round: a tensor

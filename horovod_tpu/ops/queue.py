@@ -222,11 +222,13 @@ class BackgroundRuntime:
         try:
             cfg = ctx_mod.context().config
             warn_s, shut_s = cfg.stall_warning_time_s, cfg.stall_shutdown_time_s
+            resp_s = cfg.response_timeout_s
         except Exception:
-            warn_s, shut_s = 60.0, 0.0
+            warn_s, shut_s, resp_s = 60.0, 0.0, KVController.RESPONSE_TIMEOUT_S
         return KVController(KVStoreClient(addr, int(port)),
                             rank=self.process_set.cross_rank,
                             size=self.process_set.cross_size,
+                            poll_timeout=resp_s,
                             stall_warning_s=warn_s,
                             stall_shutdown_s=shut_s)
 
