@@ -264,12 +264,20 @@ def main():
         "scan_steps": scan_steps,
         "device": jax.devices()[0].device_kind,
     }
+    # mfu is the headline quality number. vs_baseline (kept for the driver
+    # contract) divides by the only absolute throughput the reference
+    # publishes — ResNet-101 on 2017 Pascal GPUs (docs/benchmarks.rst:31-41)
+    # — an era-mismatched denominator, labeled as such in extras.
+    extras["vs_baseline_definition"] = (
+        "per-chip img/s vs reference ResNet-101 example on 16x 2017 Pascal "
+        "GPUs (docs/benchmarks.rst:31-41); era-mismatched hardware — read "
+        "mfu for the honest utilization number")
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip_ips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip_ips / BASELINE_PER_DEVICE, 3),
         "mfu": round(mfu, 4),
+        "vs_baseline": round(per_chip_ips / BASELINE_PER_DEVICE, 3),
         "extras": extras,
     }))
 

@@ -1,0 +1,25 @@
+"""Small cross-framework helpers shared by the torch/TF/MXNet shims."""
+
+from __future__ import annotations
+
+import logging
+
+LOG = logging.getLogger("horovod_tpu")
+
+_warned_64bit = False
+
+
+def warn_64bit_narrowing(dtype) -> None:
+    """Reference Horovod preserves MPI_DOUBLE/MPI_LONG on the wire
+    (common/wire/message.fbs DataType); this runtime narrows 64-bit values
+    to 32-bit (JAX runs x64-disabled — TPUs have no f64 ALUs). Silent
+    precision loss is unacceptable for e.g. f64 statistics, so say it once
+    per process."""
+    global _warned_64bit
+    if not _warned_64bit:
+        _warned_64bit = True
+        LOG.warning(
+            "collective input dtype %s rides the wire as 32-bit (JAX x64 is "
+            "disabled; TPUs have no float64 units). The caller dtype is "
+            "restored on output but precision beyond 32 bits is lost. See "
+            "docs/frameworks.md.", dtype)

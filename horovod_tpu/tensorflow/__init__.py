@@ -70,10 +70,15 @@ from .sync_batch_norm import SyncBatchNormalization  # noqa: F401
 
 def _to_np(t) -> np.ndarray:
     if isinstance(t, np.ndarray):
-        return t
-    if hasattr(t, "numpy"):
-        return t.numpy()
-    return np.asarray(t)
+        arr = t
+    elif hasattr(t, "numpy"):
+        arr = t.numpy()
+    else:
+        arr = np.asarray(t)
+    if arr.dtype in (np.float64, np.int64):
+        from ..common.util import warn_64bit_narrowing
+        warn_64bit_narrowing(arr.dtype)
+    return arr
 
 
 def _from_np(result, dtype: tf.DType) -> tf.Tensor:

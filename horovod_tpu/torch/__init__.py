@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import logging
 from typing import Optional
 
 import numpy as np
@@ -44,6 +45,7 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
     size,
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
+from horovod_tpu.common.util import warn_64bit_narrowing
 from horovod_tpu.elastic.state import ObjectState
 from horovod_tpu.torch.elastic_sampler import ElasticSampler  # noqa: F401
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
@@ -80,7 +82,12 @@ class Compression:
 _handle_meta: dict[int, tuple[Optional[torch.Tensor], Optional[torch.dtype]]] = {}
 
 
+LOG = logging.getLogger("horovod_tpu")
+
+
 def _to_np(t: torch.Tensor) -> np.ndarray:
+    if t.dtype in (torch.float64, torch.int64):
+        warn_64bit_narrowing(t.dtype)
     return t.detach().cpu().numpy()
 
 
