@@ -149,8 +149,16 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                     a.assign(tf.zeros(a.shape, a.dtype))
                 return tf.constant(True)
 
+            def skip():
+                # reference gradient_aggregation_eager.py advances
+                # optimizer.iterations on NON-aggregation steps too —
+                # iteration-keyed LR schedules must tick every step, not
+                # every bpps steps
+                self.iterations.assign_add(1)
+                return tf.constant(False)
+
             tf.cond(tf.equal(self._hvd_counter % bpps, 0),
-                    commit, lambda: tf.constant(False))
+                    commit, skip)
             return self.iterations
 
     _Distributed.__name__ = name or f"Distributed{cls.__name__}"

@@ -136,9 +136,23 @@ class ObjectState(State):
 
     def save(self):
         self._saved = self._snapshot()
-        if self._store_path:
+        if self._store_path and self._is_store_writer():
             self._ckpt.save_pytree(self._store_path, self._saved,
                                    format=self._ckpt_format)
+
+    @staticmethod
+    def _is_store_writer() -> bool:
+        """One writer per host: elastic slots on a host share one
+        HOROVOD_ELASTIC_STORE path, and concurrent commits raced in the
+        tmp/rotate dance (round-2 advisor finding). sync() broadcasts state
+        from rank 0 before commits, so any single rank's snapshot is a
+        valid resume point; the lowest local rank writes it."""
+        try:
+            from .. import local_rank
+
+            return local_rank() == 0
+        except Exception:
+            return True  # uninitialized/single-process: no peers to race
 
     def restore(self):
         if not self._saved and self._store_path and \

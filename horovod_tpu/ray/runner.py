@@ -80,6 +80,11 @@ class LocalProcessEngine:
 
         return [socket.gethostname()] * num_workers
 
+    def free_port_on(self, hostname: str) -> int:
+        from ..runner.launch import _free_port
+
+        return _free_port()  # all workers are local: a local probe is exact
+
     def run(self, fn: Callable, args: tuple, kwargs: dict) -> list:
         workdir = tempfile.mkdtemp(prefix="hvd_ray_local_")
         payload = os.path.join(workdir, "fn.pkl")
@@ -169,6 +174,31 @@ class RayEngine:
                     for _ in range(num_workers)]
         return ray.get([w.hostname.remote() for w in self._workers])
 
+    def free_port_on(self, hostname: str) -> int:
+        """Probe a free port ON the named host (round-2 advisor finding: a
+        driver-side probe says nothing about rank-0's host on a multi-node
+        cluster). Soft node affinity; falls back to a driver probe when the
+        host cannot be resolved to a Ray node."""
+        ray = self._ray
+        from ..runner.launch import _free_port
+
+        try:
+            node_id = next(
+                n["NodeID"] for n in ray.nodes()
+                if n.get("Alive") and (
+                    n.get("NodeManagerHostname") == hostname
+                    or n.get("NodeManagerAddress") == hostname))
+            from ray.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+
+            task = ray.remote(num_cpus=0)(_free_port).options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_id, soft=True))
+            return ray.get(task.remote())
+        except Exception:
+            return _free_port()
+
     def run(self, fn, args, kwargs) -> list:
         ray = self._ray
         blob = _serializer().dumps((fn, args, kwargs))
@@ -219,12 +249,9 @@ class RayExecutor:
         # SSH launcher injects — runner/launch.py slot_env). Process 0 is
         # the one that BINDS the coordinator socket, so the address must
         # be rank 0's host — not necessarily the driver (RayEngine can
-        # place worker 0 on another node). Limitation: the port is probed
-        # free on the driver; on a remote rank-0 host a collision is
-        # possible (rare: ephemeral-range port, checked moments before).
-        from ..runner.launch import _free_port
-
-        coord = f"{hostnames[0]}:{_free_port()}"
+        # place worker 0 on another node), and the free-port probe runs
+        # on that host through the engine.
+        coord = f"{hostnames[0]}:{self._engine.free_port_on(hostnames[0])}"
         for rank, e in envs.items():
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR] = addr
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT] = str(port)

@@ -29,31 +29,49 @@ def to_pandas(df):
 
 def dataframe_to_numpy(df, feature_cols: Sequence[str],
                        label_cols: Optional[Sequence[str]] = None,
-                       dtype=np.float32) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+                       dtype=np.float32,
+                       label_dtype=None) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Materialize ``df[feature_cols]`` (and labels) as dense arrays.
 
     Columns holding vectors (lists/ndarrays per row) are stacked; scalar
     columns become width-1 features and are concatenated in column order
     (the moral of reference util.py's petastorm schema prep, without the
     Parquet round-trip).
+
+    Labels preserve integer column dtypes by default (the reference's
+    petastorm path keeps column types; integer-target losses like
+    CrossEntropyLoss need integer classes, not float32). ``label_dtype``
+    forces a specific label dtype.
     """
     pdf = to_pandas(df)
 
-    def cols_to_array(cols) -> np.ndarray:
+    def target_dtype(col_dtype, explicit, preserve_int):
+        if explicit is not None:
+            return explicit
+        if preserve_int and np.issubdtype(col_dtype, np.integer):
+            return col_dtype
+        return dtype
+
+    def cols_to_array(cols, explicit=None, preserve_int=False) -> np.ndarray:
         parts = []
         for c in cols:
             v = pdf[c].to_numpy()
             if v.dtype == object:  # per-row vectors
-                part = np.stack([np.asarray(e, dtype=dtype) for e in v])
+                tgt = target_dtype(np.asarray(v[0]).dtype, explicit,
+                                   preserve_int)
+                part = np.stack([np.asarray(e, dtype=tgt) for e in v])
                 if part.ndim == 1:
                     part = part[:, None]
             else:
-                part = v.astype(dtype)[:, None]
+                part = v.astype(target_dtype(v.dtype, explicit,
+                                             preserve_int))[:, None]
             parts.append(part)
         return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
     x = cols_to_array(list(feature_cols))
-    y = cols_to_array(list(label_cols)) if label_cols else None
+    y = (cols_to_array(list(label_cols), explicit=label_dtype,
+                       preserve_int=True)
+         if label_cols else None)
     return x, y
 
 

@@ -45,21 +45,32 @@ def save_pytree(path: str, tree: Any, *, format: str = "pickle"):
     new tmp or the ``.old`` rotation on disk, and ``load_pytree``/
     ``exists`` fall back to ``.old`` — committed state is never lost.
     """
+    # tmp names are pid-qualified: concurrent committers (elastic slots on
+    # one host sharing HOROVOD_ELASTIC_STORE) must not interleave writes
+    # into one tmp inode. The elastic State additionally writes only from
+    # one rank per host, so this is defense in depth.
     if format == "orbax":
         import orbax.checkpoint as ocp
 
-        tmp, old = path + ".tmp_ckpt", path + ".old"
+        tmp, old = f"{path}.tmp_ckpt.{os.getpid()}", path + ".old"
         _rm(tmp)
         ocp.PyTreeCheckpointer().save(tmp, tree)
-        _rm(old)
-        if os.path.exists(path):
-            os.rename(path, old)
-        os.rename(tmp, path)
-        _rm(old)
+        try:
+            _rm(old)
+            if os.path.exists(path):
+                os.rename(path, old)
+            os.rename(tmp, path)
+            _rm(old)
+        except OSError:
+            # a concurrent committer won the rotation race (FileNotFoundError
+            # when our source vanished; ENOTEMPTY/EEXIST when renaming onto
+            # the winner's non-empty checkpoint dir); its snapshot is in
+            # place — drop ours
+            _rm(tmp)
         return
     if format != "pickle":
         raise ValueError(f"unknown checkpoint format {format!r}")
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(tree, f)
     os.replace(tmp, path)
