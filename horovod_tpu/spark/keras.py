@@ -18,7 +18,8 @@ class KerasEstimator:
                  store: Optional[Store] = None, num_proc: Optional[int] = None,
                  batch_size: int = 32, epochs: int = 1,
                  feature_cols=None, label_cols=None, run_id: str = "run0",
-                 verbose: int = 1, backend_env: Optional[dict] = None):
+                 verbose: int = 1, backend_env: Optional[dict] = None,
+                 label_dtype=None, staging_chunk_rows: int = 4096):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -33,6 +34,10 @@ class KerasEstimator:
         self.verbose = verbose
         # extra env for estimator-launched workers (e.g. JAX_PLATFORMS)
         self.backend_env = dict(backend_env or {})
+        # None: integer label columns stay integer (sparse CE targets)
+        self.label_dtype = label_dtype
+        # rows per staged npz chunk on the store-backed data path
+        self.staging_chunk_rows = staging_chunk_rows
 
     def checkpoint_path(self) -> str:
         if self.store is None:
@@ -80,7 +85,6 @@ class KerasEstimator:
 
         if self.model is None or not self.feature_cols or not self.label_cols:
             raise ValueError("model, feature_cols and label_cols are required")
-        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols)
         if self.optimizer is not None or self.loss is not None:
             # fill the unspecified half from the model's existing compile
             # config; silently substituting a default (e.g. "mse" on a
@@ -100,6 +104,12 @@ class KerasEstimator:
                 "estimator or compile the model first")
         import os
 
+        if self.store is not None:
+            # store-backed path: stage through the Store, stream per-rank
+            # chunks (reference spark/common/util.py:747 + petastorm)
+            return self._fit_from_store(df)
+        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols,
+                                  label_dtype=self.label_dtype)
         if (self.num_proc and self.num_proc > 1
                 and "HOROVOD_RANK" not in os.environ):
             return self._fit_multiproc(x, y)
@@ -117,37 +127,151 @@ class KerasEstimator:
             distributed = hvd_keras.cross_size() > 1
         callbacks = []
         if distributed:
-            if not getattr(self.model.optimizer.__class__, "_hvd_wrapped",
-                           False):
-                # keep the model's own compiled metrics when the estimator
-                # didn't specify any (re-compiling with [] would silently
-                # drop e.g. accuracy from a user-pre-compiled model)
-                metrics = self.metrics
-                if not metrics:
-                    try:
-                        cfg = self.model.get_compile_config() or {}
-                        m = cfg.get("metrics")
-                        if m:
-                            import keras
-
-                            metrics = [keras.metrics.deserialize(e)
-                                       if isinstance(e, dict) else e
-                                       for e in m]
-                    except Exception:
-                        metrics = None
-                self.model.compile(
-                    optimizer=hvd_keras.DistributedOptimizer(
-                        self.model.optimizer),
-                    loss=self.model.loss, metrics=metrics or None)
+            self._compile_distributed(hvd_keras)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             x, y = x[r::n], y[r::n]
             callbacks = [
                 hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
         self.model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
                        callbacks=callbacks, verbose=self.verbose)
-        if self.store is not None and (
-                not distributed or hvd_keras.cross_rank() == 0):
+        # (no checkpoint here: store-backed fits return via _fit_from_store,
+        # which owns checkpointing; the in-memory path has no store)
+        return KerasModel(self.model, self.feature_cols)
+
+    def _compile_distributed(self, hvd_keras):
+        """Wrap the model's compiled optimizer for gradient allreduce,
+        preserving the model's own compiled metrics when the estimator
+        didn't specify any (re-compiling with [] would silently drop e.g.
+        accuracy from a user-pre-compiled model)."""
+        if getattr(self.model.optimizer.__class__, "_hvd_wrapped", False):
+            return
+        metrics = self.metrics
+        if not metrics:
+            try:
+                cfg = self.model.get_compile_config() or {}
+                m = cfg.get("metrics")
+                if m:
+                    import keras
+
+                    metrics = [keras.metrics.deserialize(e)
+                               if isinstance(e, dict) else e
+                               for e in m]
+            except Exception:
+                metrics = None
+        self.model.compile(
+            optimizer=hvd_keras.DistributedOptimizer(self.model.optimizer),
+            loss=self.model.loss, metrics=metrics or None)
+
+    # -- store-backed streaming path (reference util.py:747 + petastorm) ----
+    def _fit_from_store(self, df) -> "KerasModel":
+        import os
+
+        from .common.datamodule import (StoreDataset, meta_path,
+                                        stage_dataframe)
+
+        train_path = self.store.get_train_data_path()
+        if df is not None:
+            stage_dataframe(df, self.store, train_path, self.feature_cols,
+                            self.label_cols, label_dtype=self.label_dtype,
+                            chunk_rows=self.staging_chunk_rows)
+        elif not self.store.exists(meta_path(train_path)):
+            raise ValueError("no staged dataset in the store and no "
+                             "DataFrame to stage")
+        if (self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            return self._fit_multiproc_store()
+
+        import horovod_tpu.keras as hvd_keras
+
+        distributed = False
+        callbacks = []
+        if "HOROVOD_RANK" in os.environ:
+            if not hvd_keras.is_initialized():
+                hvd_keras.init()
+            distributed = hvd_keras.cross_size() > 1
+        r = hvd_keras.cross_rank() if distributed else 0
+        n = hvd_keras.cross_size() if distributed else 1
+        if distributed:
+            self._compile_distributed(hvd_keras)
+            callbacks = [
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
+        ds = StoreDataset(self.store, train_path, shard_id=r, num_shards=n)
+        self.last_train_dataset = ds  # observability for streaming tests
+        steps = (ds.min_shard_batches(self.batch_size) if distributed
+                 else ds.shard_batches(self.batch_size))
+        if steps < 1:
+            raise ValueError("staged dataset has no rows for this shard")
+
+        def gen():
+            epoch = 0
+            while True:
+                for xb, yb in ds.batches(self.batch_size,
+                                         shuffle_seed=epoch,
+                                         limit=steps):
+                    yield xb, yb
+                epoch += 1
+
+        self.model.fit(gen(), steps_per_epoch=steps, epochs=self.epochs,
+                       callbacks=callbacks, verbose=self.verbose)
+        if not distributed or hvd_keras.cross_rank() == 0:
             self.save_checkpoint()
+        return KerasModel(self.model, self.feature_cols)
+
+    def _fit_multiproc_store(self) -> "KerasModel":
+        """num_proc workers stream their own store shards; only the model
+        bytes ride the function pickle."""
+        import os
+        import tempfile
+
+        from ..elastic.discovery import FixedHosts
+        from ..elastic.executor import ElasticFunctionExecutor, _serializer
+
+        _serializer(require_by_value=True)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.keras")
+            self.model.save(p)
+            with open(p, "rb") as f:
+                model_bytes = f.read()
+        params = dict(
+            batch_size=self.batch_size, epochs=self.epochs,
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            run_id=self.run_id, verbose=self.verbose,
+            label_dtype=self.label_dtype,
+            staging_chunk_rows=self.staging_chunk_rows)
+        store = self.store
+
+        def worker(model_bytes, store, params):
+            import os
+            import tempfile
+
+            import keras
+
+            import horovod_tpu.keras as hvd_keras
+
+            hvd_keras.init()
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "model.keras")
+                with open(p, "wb") as f:
+                    f.write(model_bytes)
+                model = keras.models.load_model(p)
+            est = KerasEstimator(model=model, store=store, **params)
+            est.fit(None)  # store path: reuses the staged chunks
+            if hvd_keras.cross_rank() == 0:
+                return model.get_weights()
+            return None
+
+        settings = ElasticFunctionExecutor.create_settings(
+            min_np=self.num_proc, max_np=self.num_proc)
+        ex = ElasticFunctionExecutor(
+            settings, FixedHosts({"localhost": self.num_proc}),
+            env_vars=dict(self.backend_env or {}))
+        ex.start()
+        try:
+            results = ex.run(worker, args=(model_bytes, store, params))
+        finally:
+            ex.shutdown()
+        weights = next(r for r in results if r is not None)
+        self.model.set_weights(weights)
         return KerasModel(self.model, self.feature_cols)
 
     def _fit_multiproc(self, x, y):

@@ -56,7 +56,8 @@ class TorchEstimator:
                  batch_size: int = 32, epochs: int = 1,
                  store: Optional[Store] = None, run_id: str = "run0",
                  backward_passes_per_step: int = 1, verbose: int = 1,
-                 backend_env: Optional[dict] = None):
+                 backend_env: Optional[dict] = None,
+                 label_dtype=None, staging_chunk_rows: int = 4096):
         self.num_proc = num_proc
         self.model = model
         self.optimizer = optimizer  # instance or factory(params)->optimizer
@@ -72,6 +73,10 @@ class TorchEstimator:
         self.verbose = verbose
         # extra env for estimator-launched workers (e.g. JAX_PLATFORMS)
         self.backend_env = dict(backend_env or {})
+        # None: integer label columns stay integer (CrossEntropy targets)
+        self.label_dtype = label_dtype
+        # rows per staged npz chunk on the store-backed data path
+        self.staging_chunk_rows = staging_chunk_rows
 
     # -- checkpoints (Store-backed, reference spark/common/store.py) --------
     def checkpoint_path(self) -> str:
@@ -116,13 +121,18 @@ class TorchEstimator:
 
         if self.model is None or not self.feature_cols or not self.label_cols:
             raise ValueError("model, feature_cols and label_cols are required")
-        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols)
-        (x, y), (x_val, y_val) = train_val_split(x, y, self.validation)
-
         if self.loss is None:
             raise ValueError(
                 "TorchEstimator requires loss= (silently defaulting to MSE "
                 "would train a classifier on the wrong objective)")
+        if self.store is not None:
+            # store-backed path: stage through the Store, stream per-rank
+            # chunks — the dataset is never materialized whole (reference
+            # spark/common/util.py:747 prepare_data + petastorm readers)
+            return self._fit_from_store(df)
+        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols,
+                                  label_dtype=self.label_dtype)
+        (x, y), (x_val, y_val) = train_val_split(x, y, self.validation)
         if (self.num_proc and self.num_proc > 1
                 and "HOROVOD_RANK" not in os.environ):
             # estimator-launched distributed fit: spawn num_proc worker
@@ -175,9 +185,162 @@ class TorchEstimator:
                 logging.getLogger("horovod_tpu").info(
                     "TorchEstimator epoch %d loss %.5f", epoch, total)
         self._log_validation(x_val, y_val)
-        if self.store is not None and (not distributed
-                                       or hvd_torch.cross_rank() == 0):
+        # (no checkpoint here: store-backed fits return via _fit_from_store,
+        # which owns checkpointing; the in-memory path has no store)
+        return TorchModel(self.model, self.feature_cols)
+
+    # -- store-backed streaming path (reference util.py:747 + petastorm) ----
+    def _fit_from_store(self, df) -> TorchModel:
+        import os
+
+        from .common.datamodule import (StoreDataset, load_meta, meta_path,
+                                        stage_dataframe)
+
+        train_path = self.store.get_train_data_path()
+        if df is not None:
+            # stage once on the driver; worker re-entry passes df=None and
+            # reuses the staged chunks (reference prepare_data caches by
+            # dataset index — here one staged dataset per store prefix).
+            # The validation split reserves whole tail chunks, so cap the
+            # chunk size to the validation row budget when it is known.
+            chunk_rows = self.staging_chunk_rows
+            if self.validation and hasattr(df, "__len__"):
+                chunk_rows = min(chunk_rows, max(
+                    1, int(len(df) * float(self.validation))))
+            stage_dataframe(df, self.store, train_path, self.feature_cols,
+                            self.label_cols, label_dtype=self.label_dtype,
+                            chunk_rows=chunk_rows)
+        elif not self.store.exists(meta_path(train_path)):
+            raise ValueError("no staged dataset in the store and no "
+                             "DataFrame to stage")
+        if (self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            return self._fit_multiproc_store()
+
+        import horovod_tpu.torch as hvd_torch
+
+        try:
+            distributed = (hvd_torch.is_initialized()
+                           and hvd_torch.cross_size() > 1)
+        except Exception:
+            distributed = False
+        r = hvd_torch.cross_rank() if distributed else 0
+        n = hvd_torch.cross_size() if distributed else 1
+        n_chunks = load_meta(self.store, train_path)["n_chunks"]
+        n_val = 0
+        if self.validation:
+            if n_chunks < 2:
+                raise ValueError(
+                    "validation split on the store path reserves whole "
+                    "chunks; stage at least 2 chunks (lower "
+                    "staging_chunk_rows)")
+            n_val = max(1, round(float(self.validation) * n_chunks))
+            n_val = min(n_val, n_chunks - 1)
+        train_chunks = list(range(n_chunks - n_val))
+        ds = StoreDataset(self.store, train_path, shard_id=r, num_shards=n,
+                          chunks=train_chunks)
+        val_ds = (StoreDataset(self.store, train_path, shard_id=0,
+                               num_shards=1,
+                               chunks=list(range(n_chunks - n_val, n_chunks)))
+                  if n_val else None)
+        return self._train_streaming(ds, val_ds, distributed)
+
+    def _train_streaming(self, ds, val_ds, distributed: bool) -> TorchModel:
+        import logging
+
+        import numpy as np
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        opt = self._make_optimizer()
+        if distributed:
+            opt = hvd_torch.DistributedOptimizer(
+                opt, named_parameters=self.model.named_parameters(),
+                backward_passes_per_step=self.backward_passes_per_step)
+            hvd_torch.broadcast_parameters(self.model.state_dict(),
+                                           root_rank=0)
+        # symmetric step count: every rank must run the same number of
+        # optimizer steps per epoch (each step allreduces); computed from
+        # staged metadata alone, no negotiation round. Tail batches beyond
+        # the smallest shard are skipped (documented).
+        limit = (ds.min_shard_batches(self.batch_size) if distributed
+                 else None)
+        if (limit == 0) or (not distributed and len(ds) == 0):
+            raise ValueError(
+                "staged dataset has no rows for some shard — zero optimizer "
+                "steps would silently train nothing (restage with smaller "
+                "staging_chunk_rows or fewer workers)")
+        self.last_train_dataset = ds  # observability (tests assert the
+        #                               streaming property on it)
+        loss_fn = self.loss
+        self.model.train()
+        for epoch in range(self.epochs):
+            total, steps = 0.0, 0
+            for xb, yb in ds.batches(self.batch_size, shuffle_seed=epoch,
+                                     limit=limit):
+                xt = torch.from_numpy(np.ascontiguousarray(xb))
+                yt = torch.from_numpy(np.ascontiguousarray(yb))
+                opt.zero_grad()
+                loss = loss_fn(self.model(xt), yt)
+                loss.backward()
+                opt.step()
+                total += float(loss.detach())
+                steps += 1
+            if self.verbose:
+                logging.getLogger("horovod_tpu").info(
+                    "TorchEstimator[store] epoch %d loss %.5f (%d steps)",
+                    epoch, total / max(steps, 1), steps)
+        if val_ds is not None and self.verbose:
+            self.model.eval()
+            vtotal, vn = 0.0, 0
+            with torch.no_grad():
+                for xb, yb in val_ds.batches(self.batch_size):
+                    vtotal += float(loss_fn(
+                        self.model(torch.from_numpy(np.ascontiguousarray(xb))),
+                        torch.from_numpy(np.ascontiguousarray(yb))))
+                    vn += 1
+            logging.getLogger("horovod_tpu").info(
+                "TorchEstimator[store] validation loss %.5f",
+                vtotal / max(vn, 1))
+            self.model.train()
+        if not distributed or hvd_torch.cross_rank() == 0:
             self.save_checkpoint()
+        return TorchModel(self.model, self.feature_cols)
+
+    def _fit_multiproc_store(self) -> TorchModel:
+        """num_proc workers stream their own store shards — no dataset
+        bytes ride the function pickle (reference: executors read their
+        petastorm shard straight from the store)."""
+        from ..elastic.discovery import FixedHosts
+        from ..elastic.executor import ElasticFunctionExecutor, _serializer
+
+        _serializer(require_by_value=True)
+
+        def worker(est):
+            import horovod_tpu
+
+            horovod_tpu.init()
+            import horovod_tpu.torch as hvd_torch
+
+            est.fit(None)  # store path: reuses the staged chunks
+            if hvd_torch.cross_rank() == 0:
+                return {k: v.cpu()
+                        for k, v in est.model.state_dict().items()}
+            return None
+
+        settings = ElasticFunctionExecutor.create_settings(
+            min_np=self.num_proc, max_np=self.num_proc)
+        ex = ElasticFunctionExecutor(
+            settings, FixedHosts({"localhost": self.num_proc}),
+            env_vars=dict(self.backend_env or {}))
+        ex.start()
+        try:
+            results = ex.run(worker, args=(self,))
+        finally:
+            ex.shutdown()
+        state = next(r for r in results if r is not None)
+        self.model.load_state_dict(state)
         return TorchModel(self.model, self.feature_cols)
 
     def _log_validation(self, x_val, y_val):
