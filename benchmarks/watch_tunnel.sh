@@ -2,7 +2,7 @@
 # Tunnel-recovery watcher: probes the TPU; on recovery runs the MFU
 # campaign once. Log: benchmarks/watch.log
 cd "$(dirname "$0")/.." || exit 1
-for i in $(seq 1 60); do
+for i in $(seq 1 150); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     echo "TUNNEL-HEALED attempt $i $(date +%H:%M:%S)"
     timeout 3000 python benchmarks/mfu_campaign.py 2>&1 | grep -v WARNING
