@@ -230,13 +230,16 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
             if op == ReduceOp.AVERAGE:
                 r = jnp.mean(g, axis=0)
             elif op == ReduceOp.SUM:
-                r = jnp.sum(g, axis=0)
+                # dtype=: jnp.sum widens small ints (u8→u32); the wire
+                # contract returns the caller's dtype (reference preserves
+                # the MPI datatype end to end)
+                r = jnp.sum(g, axis=0, dtype=g.dtype)
             elif op == ReduceOp.MIN:
                 r = jnp.min(g, axis=0)
             elif op == ReduceOp.MAX:
                 r = jnp.max(g, axis=0)
             elif op == ReduceOp.PRODUCT:
-                r = jnp.prod(g, axis=0)
+                r = jnp.prod(g, axis=0, dtype=g.dtype)
             elif op == ReduceOp.ADASUM:
                 from .adasum import adasum_tree_reduce
 
@@ -399,16 +402,24 @@ def _eager_alltoall(x, splits, ps: ProcessSet):
     for p in range(nproc):
         send[p, : splits[p]] = xl[offs[p] : offs[p + 1]]
 
-    key = ("alltoall", ps.name, send.shape, str(send.dtype), me)
+    # One IDENTICAL program on every process (multi-process SPMD executes
+    # in lockstep — a per-process `g[:, me]` would be a different program
+    # per rank and corrupts the exchange): transpose [src, dest, ...] →
+    # [dest, src, ...] with the output sharded over dest, which XLA lowers
+    # to the actual all-to-all over the process axis. Each process then
+    # reads its own addressable row — its received column.
+    key = ("alltoall", ps.name, send.shape, str(send.dtype))
 
     def build():
-        def f(g):  # g: [nproc, nproc, maxs, ...]; take column `me`
-            return g[:, me]
+        def f(g):  # g: [src, dest, maxs, ...] -> [dest, src, maxs, ...]
+            return jnp.swapaxes(g, 0, 1)
 
-        return jax.jit(f, out_shardings=_replicated(ps))
+        return jax.jit(
+            f, out_shardings=NamedSharding(ps.mesh_2d, P(PROC_AXIS)))
 
     g = _global_row_array(ps, send)
-    col = _to_local_np(_cached(key, build)(g))  # [nproc, maxs, ...]
+    res = _cached(key, build)(g)
+    col = np.asarray(res.addressable_data(0))[0]  # [src, maxs, ...]
     parts = [col[p, : recv_splits[p]] for p in range(nproc)]
     return jnp.asarray(np.concatenate(parts, axis=0)), jnp.asarray(recv_splits)
 
@@ -511,11 +522,14 @@ def grouped_allreduce(
             jnp.asarray(t).dtype if on_device(t) else np.asarray(t).dtype,
             []).append(i)
     for dt, idxs in by_dtype.items():
-        flats = [jnp.ravel(tensors[i]) if on_device(tensors[i])
-                 else np.ravel(tensors[i]) for i in idxs]
+        # per-GROUP backend choice: one device-resident member keeps the
+        # whole fused buffer on device (np.concatenate on a mixed list
+        # would pull the jax.Arrays back to host)
+        use_dev = any(on_device(tensors[i]) for i in idxs)
+        flats = [(jnp.ravel if use_dev else np.ravel)(tensors[i])
+                 for i in idxs]
         sizes = [f.shape[0] for f in flats]
-        fused = (jnp.concatenate(flats) if on_device(tensors[idxs[0]])
-                 else np.concatenate(flats))
+        fused = (jnp if use_dev else np).concatenate(flats)
         red = allreduce(fused, op=op, axis_name=axis_name, process_set=process_set,
                         prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor)
@@ -599,8 +613,14 @@ def broadcast(
     """
     if _is_traced(tensor):
         idx = lax.axis_index(axis_name)
-        zero = jnp.zeros_like(tensor)
-        return lax.psum(jnp.where(idx == root_rank, tensor, zero), axis_name)
+        t = tensor
+        if t.dtype == jnp.bool_:
+            t = t.astype(jnp.uint8)  # psum promotes bool to int32
+        out = lax.psum(jnp.where(idx == root_rank, t, jnp.zeros_like(t)),
+                       axis_name)
+        # psum may widen small dtypes; the caller's dtype comes back
+        return (out.astype(tensor.dtype) if out.dtype != tensor.dtype
+                else out)
     return _eager_broadcast(tensor, root_rank, _ps(process_set))
 
 

@@ -68,6 +68,11 @@ def entry_signature(entry) -> list:
         return cached
     t = entry.tensor
     shape = list(getattr(t, "shape", []))
+    # allgather/alltoall are ragged in the first dimension by contract
+    # (reference controller.cc:596: "all dimensions, except the first,
+    # must be the same"), so the first dim is not consistency-checked
+    if entry.op in ("allgather", "alltoall") and shape:
+        shape[0] = "*"
     dtype = str(getattr(t, "dtype", type(t).__name__))
     ps = getattr(entry, "process_set", None)
     ps_name = getattr(ps, "name", None) or "global"

@@ -395,8 +395,8 @@ class BackgroundRuntime:
         Allgather contributes an empty first dim (ragged support makes the
         zero-row contribution exact, not padded)."""
         op, dtype, shape = sig[0], sig[1], list(sig[2])
-        if op == "allgather" and shape:
-            shape[0] = 0
+        if op in ("allgather", "alltoall") and shape:
+            shape[0] = 0  # ragged ops: the sig's first dim is the "*" mark
         return TensorEntry(
             name=name, op=op, tensor=np.zeros(shape, dtype=np.dtype(dtype)),
             reduce_op=C.ReduceOp(sig[3]), root_rank=sig[4],
