@@ -28,7 +28,7 @@ class State:
 
     def __init__(self, **kwargs):
         self._reset_callbacks: list[Callable] = []
-        self._host_messages = _HostUpdateListener()
+        self._host_messages = _host_update_listener()
 
     def register_reset_callbacks(self, callbacks):
         self._reset_callbacks.extend(callbacks)
@@ -65,30 +65,61 @@ class State:
 
 
 class _HostUpdateListener:
-    """Polls the driver's discovery epoch in the rendezvous KV store.
+    """Watches the driver's discovery epoch in the rendezvous KV store.
 
-    Replaces the reference's push-based WorkerNotificationService
-    (elastic/worker.py): the driver bumps ``elastic/epoch``; workers
-    compare against the epoch they started from (env HOROVOD_ELASTIC_EPOCH).
+    Push-shaped replacement for the reference's WorkerNotificationService
+    (runner/elastic/worker.py): ONE daemon thread per process (shared by
+    every State, like the reference's single notification service) polls
+    ``elastic/epoch`` every ~1 s and latches a flag when the driver bumps
+    it, so ``check_host_updates()`` at commit points is a flag read —
+    membership changes surface at the next commit within ~1 s of the
+    bump, however long the commit interval is, and commits never block
+    on HTTP.
     """
 
+    WATCH_INTERVAL_S = 1.0
+
     def __init__(self):
+        import threading
+
         self._base_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
         addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
         port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
         self._client = None
+        self._forced = False
+        self._lock = threading.Lock()
+        self._updated = threading.Event()
+        self._stop = threading.Event()
         if addr and port:
             from ..runner.http_server import KVStoreClient
 
             self._client = KVStoreClient(addr, int(port))
-        self._forced = False
+            threading.Thread(target=self._watch, daemon=True,
+                             name="hvd-host-updates").start()
+
+    def _watch(self):
+        while not self._stop.is_set():
+            cur = self.current_epoch()  # HTTP outside the lock
+            with self._lock:
+                # compare under the lock against the *current* base: a
+                # clear() that rebased while our GET was in flight must not
+                # be overridden by the stale comparison (spurious restart)
+                if cur != self._base_epoch:
+                    self._updated.set()
+            self._stop.wait(self.WATCH_INTERVAL_S)
 
     def bump(self):
         self._forced = True
 
     def clear(self):
-        self._forced = False
-        self._base_epoch = self.current_epoch()
+        cur = self.current_epoch()
+        with self._lock:
+            self._forced = False
+            self._base_epoch = cur
+            self._updated.clear()
+
+    def stop(self):
+        self._stop.set()
 
     def current_epoch(self) -> int:
         if self._client is None:
@@ -99,7 +130,23 @@ class _HostUpdateListener:
             return self._base_epoch
 
     def changed(self) -> bool:
-        return self._forced or self.current_epoch() != self._base_epoch
+        return self._forced or self._updated.is_set()
+
+
+_shared_listener: Optional[_HostUpdateListener] = None
+
+
+def _host_update_listener() -> _HostUpdateListener:
+    """Process-wide singleton: many State instances, one watcher thread
+    (and one rebuilt if the rendezvous env appears after the first use)."""
+    global _shared_listener
+    if (_shared_listener is None
+            or (_shared_listener._client is None
+                and os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))):
+        if _shared_listener is not None:
+            _shared_listener.stop()
+        _shared_listener = _HostUpdateListener()
+    return _shared_listener
 
 
 class ObjectState(State):

@@ -249,3 +249,52 @@ def test_jax_state_orbax_checkpoint_roundtrip(tmp_path):
     assert s2.epoch == 7
     np.testing.assert_allclose(np.asarray(s2.params["w"]),
                                np.arange(4.0) + 10.0)
+
+
+def test_host_update_watcher_interrupts_next_commit(monkeypatch):
+    """VERDICT r2 #8: membership changes surface at the next commit within
+    ~1 s of the driver's epoch bump (push-shaped watcher thread), without
+    the worker's commit cadence mattering (reference
+    runner/elastic/worker.py WorkerNotificationService)."""
+    import time
+
+    from horovod_tpu.runner.http_server import KVStoreClient, RendezvousServer
+
+    server = RendezvousServer()
+    port = server.start()
+    client = KVStoreClient("127.0.0.1", port)
+    client.put("elastic", "epoch", b"0")
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", str(port))
+    monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "0")
+    try:
+        state = ObjectState(epoch=0)
+        state.commit()  # no change yet: must not interrupt
+
+        # commits are flag reads, not HTTP round-trips
+        t0 = time.perf_counter()
+        for _ in range(50):
+            state.commit()
+        assert (time.perf_counter() - t0) < 0.5
+
+        # driver bumps the discovery epoch mid-epoch
+        client.put("elastic", "epoch", b"1")
+        deadline = time.monotonic() + 5.0
+        interrupted = False
+        while time.monotonic() < deadline:
+            try:
+                state.commit()
+            except HostsUpdatedInterrupt:
+                interrupted = True
+                interrupted_after = time.monotonic() - (deadline - 5.0)
+                break
+            time.sleep(0.1)
+        assert interrupted
+        # within ~1 commit interval of the watcher noticing (~1 s poll)
+        assert interrupted_after < 3.0, interrupted_after
+
+        # reset clears the latch and rebases on the new epoch
+        state.on_reset()
+        state.commit()  # no further interrupt
+    finally:
+        server.stop()
