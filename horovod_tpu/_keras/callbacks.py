@@ -146,9 +146,14 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
     """Track batch/epoch progress in elastic state (reference keras
     elastic UpdateBatchStateCallback). Keras 3's fit loop cannot skip
     already-processed batches from a callback (the reference shrank
-    ``params['steps']``, a Keras-2 mechanism), so a resumed worker
-    restarts its epoch; ``state.batch`` remains available for users who
-    shard their dataset to continue mid-epoch.
+    ``params['steps']``, a Keras-2 mechanism), so mid-epoch resume is
+    dataset-side: restart ``model.fit`` with a dataset that skips
+    ``state.batch`` batches and ``steps_per_epoch`` reduced to match
+    (see docs/elastic.md and test_keras_api.py's mid-epoch resume test).
+    This callback supports that contract by offsetting Keras's
+    within-fit batch index with the restored ``state.batch`` on the
+    resumed epoch (the reference's ``state.batch + batch + 1``), so the
+    committed counter stays the TRUE epoch position.
 
     Order this callback BEFORE CommitStateCallback in the callbacks list
     (Keras invokes callbacks in order) so commits persist the updated
@@ -157,15 +162,27 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
     def __init__(self, state):
         super().__init__()
         self.state = state
+        self._offset = 0
+        self._resumed_fit = False
+
+    def on_train_begin(self, logs=None):
+        # resuming mid-epoch: Keras restarts batch numbering at 0, but
+        # state.batch batches of this epoch are already done
+        self._offset = int(getattr(self.state, "batch", 0) or 0)
+        self._resumed_fit = True
 
     def on_batch_end(self, batch, logs=None):
-        self.state.batch = batch + 1
+        self.state.batch = self._offset + batch + 1
 
     def on_epoch_begin(self, epoch, logs=None):
+        if not self._resumed_fit:
+            self._offset = 0  # later epochs of this fit start at batch 0
+        self._resumed_fit = False
         self.state.epoch = epoch
 
     def on_epoch_end(self, epoch, logs=None):
         # the durable epoch-boundary snapshot is "next epoch, batch 0" —
         # a worker restored from it must not repeat the completed epoch
+        self._offset = 0
         self.state.batch = 0
         self.state.epoch = epoch + 1

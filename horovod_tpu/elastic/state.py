@@ -28,18 +28,25 @@ class State:
 
     def __init__(self, **kwargs):
         self._reset_callbacks: list[Callable] = []
-        self._host_messages = _host_update_listener()
+        self._hm_forced = False
+        # per-State acknowledgment of the shared listener's notification
+        # count: every State observes every membership change (the
+        # reference's WorkerNotificationManager delivers to every
+        # registered state's own queue — consume-once-per-state, not
+        # consume-once-per-process)
+        self._hm_ack = _host_update_listener().change_count
 
     def register_reset_callbacks(self, callbacks):
         self._reset_callbacks.extend(callbacks)
 
     def on_reset(self):
-        self._host_messages.clear()
+        self._hm_forced = False
+        self._hm_ack = _host_update_listener().change_count
         for cb in self._reset_callbacks:
             cb()
 
     def on_hosts_updated(self):
-        self._host_messages.bump()
+        self._hm_forced = True
 
     def commit(self):
         """Snapshot + check for membership changes (reference :60-72:
@@ -51,7 +58,8 @@ class State:
         """Raise HostsUpdatedInterrupt if membership changed
         (reference :73-96; consistency across ranks comes from every
         worker polling the same driver epoch)."""
-        if self._host_messages.changed():
+        if (self._hm_forced
+                or _host_update_listener().change_count > self._hm_ack):
             raise HostsUpdatedInterrupt(skip_sync=False)
 
     def save(self):
@@ -70,25 +78,32 @@ class _HostUpdateListener:
     Push-shaped replacement for the reference's WorkerNotificationService
     (runner/elastic/worker.py): ONE daemon thread per process (shared by
     every State, like the reference's single notification service) polls
-    ``elastic/epoch`` every ~1 s and latches a flag when the driver bumps
-    it, so ``check_host_updates()`` at commit points is a flag read —
-    membership changes surface at the next commit within ~1 s of the
-    bump, however long the commit interval is, and commits never block
-    on HTTP.
+    ``elastic/epoch`` every ~1 s and increments ``change_count`` whenever
+    the observed epoch moves. States remember the count they last
+    acknowledged, so ``check_host_updates()`` at commit points is an
+    integer compare — membership changes surface at the next commit
+    within ~1 s of the bump, commits never block on HTTP, every State
+    sees every change, and a reset acknowledges exactly the changes that
+    reset absorbed (no clear/watcher race: the watcher owns all its
+    state; the single watcher thread's GETs are sequential, so the
+    observed epoch sequence is ordered).
     """
 
     WATCH_INTERVAL_S = 1.0
 
-    def __init__(self):
+    def __init__(self, carry: Optional[tuple] = None):
         import threading
 
-        self._base_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+        self._seen_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
         addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
         port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        self.env_key = (addr, port)
         self._client = None
-        self._forced = False
-        self._lock = threading.Lock()
-        self._updated = threading.Event()
+        self.change_count = 0
+        if carry is not None:
+            # a rebuild must not invalidate States' acknowledged counts:
+            # the counter is monotonic across listener generations
+            self._seen_epoch, self.change_count = carry
         self._stop = threading.Event()
         if addr and port:
             from ..runner.http_server import KVStoreClient
@@ -99,53 +114,42 @@ class _HostUpdateListener:
 
     def _watch(self):
         while not self._stop.is_set():
-            cur = self.current_epoch()  # HTTP outside the lock
-            with self._lock:
-                # compare under the lock against the *current* base: a
-                # clear() that rebased while our GET was in flight must not
-                # be overridden by the stale comparison (spurious restart)
-                if cur != self._base_epoch:
-                    self._updated.set()
+            cur = self._fetch_epoch()
+            if cur is not None and cur != self._seen_epoch:
+                self._seen_epoch = cur
+                self.change_count += 1
             self._stop.wait(self.WATCH_INTERVAL_S)
 
-    def bump(self):
-        self._forced = True
-
-    def clear(self):
-        cur = self.current_epoch()
-        with self._lock:
-            self._forced = False
-            self._base_epoch = cur
-            self._updated.clear()
-
-    def stop(self):
-        self._stop.set()
-
-    def current_epoch(self) -> int:
+    def _fetch_epoch(self) -> Optional[int]:
         if self._client is None:
-            return self._base_epoch
+            return None
         try:
             return int(self._client.get("elastic", "epoch", timeout=1.0))
         except Exception:
-            return self._base_epoch
+            return None
 
-    def changed(self) -> bool:
-        return self._forced or self._updated.is_set()
+    def stop(self):
+        self._stop.set()
 
 
 _shared_listener: Optional[_HostUpdateListener] = None
 
 
 def _host_update_listener() -> _HostUpdateListener:
-    """Process-wide singleton: many State instances, one watcher thread
-    (and one rebuilt if the rendezvous env appears after the first use)."""
+    """Process-wide singleton: many State instances, one watcher thread.
+    Rebuilt when the rendezvous env appears or points somewhere new, so
+    States never keep watching a dead store; States re-resolve the
+    singleton on every use rather than capturing a reference."""
     global _shared_listener
-    if (_shared_listener is None
-            or (_shared_listener._client is None
-                and os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))):
+    env_key = (os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
+               os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT"))
+    if _shared_listener is None or _shared_listener.env_key != env_key:
+        carry = None
         if _shared_listener is not None:
             _shared_listener.stop()
-        _shared_listener = _HostUpdateListener()
+            carry = (_shared_listener._seen_epoch,
+                     _shared_listener.change_count)
+        _shared_listener = _HostUpdateListener(carry)
     return _shared_listener
 
 

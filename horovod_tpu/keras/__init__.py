@@ -32,6 +32,7 @@ from horovod_tpu import (  # noqa: F401
 )
 from horovod_tpu._keras import create_distributed_optimizer
 from horovod_tpu._keras import callbacks  # noqa: F401
+from horovod_tpu.keras import elastic  # noqa: F401
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 

@@ -1,0 +1,61 @@
+"""Keras elastic API (reference horovod/tensorflow/keras/elastic.py and
+horovod/keras/elastic.py): `KerasState`, `run`, and the state-tracking
+callbacks under the Keras namespace, so ``hvd.elastic.run`` /
+``hvd.elastic.KerasState`` work exactly like the reference's.
+
+Unlike the reference (which routes through the TF backend), KerasState
+here is Keras-3-native — ``get_weights``/``set_weights`` plus optimizer
+variables — so ``horovod_tpu.keras`` keeps importing in environments
+without TensorFlow (Keras-on-JAX backends).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import horovod_tpu as _core
+from horovod_tpu._keras.callbacks import (  # noqa: F401
+    CommitStateCallback,
+    UpdateBatchStateCallback,
+)
+from horovod_tpu.elastic import run  # noqa: F401
+from horovod_tpu.elastic.state import ObjectState
+
+
+class KerasState(ObjectState):
+    """State of a Keras model + optimizer (reference
+    tensorflow/keras/elastic.py:22 KerasState): commit() snapshots
+    weights host-side, restore() assigns them back, sync() broadcasts
+    from rank 0."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        self._weights_saved = None
+        self._opt_saved = None
+        super().__init__(**kwargs)
+
+    def _opt_vars(self):
+        return list(getattr(self.optimizer, "variables", []) or [])
+
+    def save(self):
+        self._weights_saved = [np.copy(w) for w in self.model.get_weights()]
+        self._opt_saved = [np.asarray(v) for v in self._opt_vars()]
+        super().save()
+
+    def restore(self):
+        if self._weights_saved is not None:
+            self.model.set_weights(self._weights_saved)
+        if self._opt_saved:
+            for v, s in zip(self._opt_vars(), self._opt_saved):
+                v.assign(s)
+        super().restore()
+
+    def sync(self):
+        if _core.cross_size() > 1:
+            from horovod_tpu.keras import broadcast_variables
+
+            broadcast_variables(self.model.variables, root_rank=0)
+            if self._opt_vars():
+                broadcast_variables(self._opt_vars(), root_rank=0)
+        super().sync()

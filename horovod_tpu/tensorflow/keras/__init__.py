@@ -12,6 +12,7 @@ from horovod_tpu.keras import (  # noqa: F401
     broadcast_object,
     broadcast_variables,
     callbacks,
+    elastic,
     cross_rank,
     cross_size,
     init,
