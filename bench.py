@@ -135,20 +135,26 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
 
 
 def bench_eager_allreduce(nbytes: int = 64 << 20, iters: int = 10,
-                          compressed: bool = False):
+                          compressed: bool = False,
+                          device_resident: bool = False):
     """Eager fused allreduce GB/s (BASELINE metric; config 3 = compressed
     wire). Single process: measures the host↔device staging + reduction
-    path; multi-process adds the cross-process collective."""
+    path; multi-process adds the cross-process collective.
+    ``device_resident``: feed a committed jax.Array (the fast path that
+    skips host staging — VERDICT r2 #7)."""
     from horovod_tpu.ops.compression import Compression
 
     x = np.random.RandomState(2).randn(nbytes // 4).astype(np.float32)
+    if device_resident:
+        x = jnp.asarray(x)
+        jax.block_until_ready(x)
     comp = Compression.bf16 if compressed else Compression.none
-    tag = "c" if compressed else "r"
+    tag = ("c" if compressed else "r") + ("d" if device_resident else "")
 
     def run_one(i):
         t, ctx = comp.compress(jnp.asarray(x)) if compressed else (x, None)
-        h = hvd.allreduce_async(np.asarray(t), name=f"bench.ar.{tag}{i}",
-                                op=hvd.Sum)
+        h = hvd.allreduce_async(t if device_resident else np.asarray(t),
+                                name=f"bench.ar.{tag}{i}", op=hvd.Sum)
         out = hvd.synchronize(h)
         return comp.decompress(out, ctx) if compressed else out
 

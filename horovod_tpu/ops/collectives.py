@@ -155,6 +155,30 @@ def clear_eager_cache():
     _EAGER_CACHE.clear()
 
 
+def unpack_flat(red, sizes: tuple, shapes: tuple):
+    """Split a flat fused result back into per-tensor views, under jit.
+
+    Eager slicing (``red[off:off+n]``) lowers to dynamic_slice whose start
+    index rides as a scalar *argument* — one host→device transfer per
+    tensor, forbidden on the device-resident path. Inside jit the offsets
+    are program constants and XLA fuses the whole unpack. Cached by
+    (sizes, shapes, dtype) like any other eager program."""
+    key = ("unpack_flat", sizes, shapes, str(red.dtype))
+
+    def build():
+        def f(r):
+            parts = []
+            off = 0
+            for n, shape in zip(sizes, shapes):
+                parts.append(jnp.reshape(
+                    lax.slice(r, (off,), (off + n,)), shape))
+                off += n
+            return parts
+        return jax.jit(f)
+
+    return _cached(key, build)(red)
+
+
 def _global_row_array(ps: ProcessSet, local):
     """Assemble G[nproc, ...] where G[p] is process p's contribution,
     sharded over the process axis and replicated over local chips.
@@ -536,25 +560,8 @@ def grouped_allreduce(
         red = allreduce(fused, op=op, axis_name=axis_name, process_set=process_set,
                         prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor)
-        # unpack under jit: eager slicing stages slice offsets as scalar
-        # arguments (a host→device transfer per tensor, forbidden on the
-        # device-resident path); inside jit the offsets are program
-        # constants and XLA fuses the whole unpack
         shapes = tuple(tuple(tensors[i].shape) for i in idxs)
-        key = ("grouped_unpack", tuple(sizes), shapes, str(dt))
-
-        def build(sizes=tuple(sizes), shapes=shapes):
-            def f(r):
-                parts = []
-                off = 0
-                for n, shape in zip(sizes, shapes):
-                    parts.append(jnp.reshape(
-                        lax.slice(r, (off,), (off + n,)), shape))
-                    off += n
-                return parts
-            return jax.jit(f)
-
-        for i, p in zip(idxs, _cached(key, build)(red)):
+        for i, p in zip(idxs, unpack_flat(red, tuple(sizes), shapes)):
             out[i] = p
     if compression is not None:
         out = [compression.decompress(o, c) for o, c in zip(out, dectxs)]

@@ -320,8 +320,12 @@ class BackgroundRuntime:
         singles: list[TensorEntry] = []
         for e in batch:
             if e.op == "allreduce" and e.reduce_op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
-                arr = np.asarray(e.tensor)
-                key = (str(arr.dtype), int(e.reduce_op), e.prescale_factor,
+                # metadata only — np.asarray here would pull a
+                # device-resident jax.Array to host just to read its dtype
+                t = e.tensor
+                dtype = str(getattr(t, "dtype", None)
+                            or np.asarray(t).dtype)
+                key = (dtype, int(e.reduce_op), e.prescale_factor,
                        e.postscale_factor, id(e.process_set))
                 fusable.setdefault(key, []).append(e)
             else:
@@ -434,7 +438,9 @@ class BackgroundRuntime:
         nbytes = 0
         chunks = []
         for e in group:
-            sz = np.asarray(e.tensor).nbytes
+            sz = getattr(e.tensor, "nbytes", None)
+            if sz is None:  # explicit None check: nbytes == 0 is valid
+                sz = np.asarray(e.tensor).nbytes
             if chunk and nbytes + sz > self.fusion_threshold:
                 chunks.append(chunk)
                 chunk, nbytes = [], 0
@@ -448,11 +454,27 @@ class BackgroundRuntime:
                 for n in names:
                     self.timeline.start_activity(n, "FUSED_ALLREDUCE")
             try:
-                arrs = [np.asarray(e.tensor) for e in chunk]
-                if len(arrs) > 1:
-                    fused = self.fusion_buffer.pack(arrs)
+                import jax as _jax
+                import jax.numpy as _jnp
+
+                # device-resident chunk: fuse on device (jnp.concatenate)
+                # instead of the host fusion buffer — gradients that
+                # already live in HBM never round-trip through the host
+                # (reference NCCL path reduces the GPU buffer in place)
+                on_dev = all(isinstance(e.tensor, _jax.Array)
+                             and e.tensor.is_fully_addressable
+                             for e in chunk)
+                if on_dev:
+                    arrs = [e.tensor for e in chunk]
+                    flats = [_jnp.ravel(a) for a in arrs]
+                    fused = flats[0] if len(flats) == 1 \
+                        else _jnp.concatenate(flats)
                 else:
-                    fused = arrs[0].ravel()
+                    arrs = [np.asarray(e.tensor) for e in chunk]
+                    if len(arrs) > 1:
+                        fused = self.fusion_buffer.pack(arrs)
+                    else:
+                        fused = arrs[0].ravel()
                 e0 = chunk[0]
                 red = C._eager_allreduce(
                     fused, e0.reduce_op, e0.process_set or self.process_set,
@@ -460,11 +482,13 @@ class BackgroundRuntime:
                 self.bytes_processed += fused.nbytes
                 # results stay device-side lazy slices: the cycle thread
                 # must not block on completion (async contract; callers
-                # observe readiness per-handle)
-                off = 0
-                for e, a in zip(chunk, arrs):
-                    self._finish(e, red[off:off + a.size].reshape(a.shape))
-                    off += a.size
+                # observe readiness per-handle). Jitted unpack: no scalar
+                # offset staging (see collectives.unpack_flat).
+                parts = C.unpack_flat(
+                    red, tuple(int(a.size) for a in arrs),
+                    tuple(tuple(a.shape) for a in arrs))
+                for e, p in zip(chunk, parts):
+                    self._finish(e, p)
             except Exception as exc:  # fail the whole chunk
                 for e in chunk:
                     self._finish(e, None,

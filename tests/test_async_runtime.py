@@ -90,3 +90,26 @@ def test_timeline_writes_events(tmp_path):
 
     events = json.loads(text)
     assert isinstance(events, list) and len(events) > 3
+
+
+def test_async_fused_allreduce_device_resident_no_host_copy():
+    """Device-resident jax.Array gradients through the ASYNC queue fuse on
+    device (jnp.concatenate), never the host fusion buffer (reference NCCL
+    in-place GPU reduction, nccl_operations.cc:126). Global transfer guard
+    covers the background cycle thread."""
+    import jax
+    import jax.numpy as jnp
+
+    hvd.init()
+    xs = [jnp.arange(256, dtype=jnp.float32) + i for i in range(3)]
+    jax.block_until_ready(xs)
+    jax.config.update("jax_transfer_guard", "disallow")
+    try:
+        hs = [hvd.allreduce_async(x, op=hvd.Sum, name=f"dev.async.{i}")
+              for i, x in enumerate(xs)]
+        outs = [hvd.synchronize(h) for h in hs]
+        jax.block_until_ready(outs)
+    finally:
+        jax.config.update("jax_transfer_guard", "allow")
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(xs[i]))
