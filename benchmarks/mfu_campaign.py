@@ -54,10 +54,45 @@ def main():
     record(event="matmul4096", ms=dt * 1e3, tflops=2 * n ** 3 / dt / 1e12,
            mfu=2 * n ** 3 / dt / PEAK)
 
-    # 2. batch × scan sweep on the real training step
+    # 1b. conv peaks — round-2 ablation said fwd-only is ~14% MFU, so the
+    # deficit is the conv stack or dispatch latency; measure what the
+    # chip's convs can deliver in isolation (stem 7x7/s2 + bottleneck 3x3)
+    def conv_peak(tag, x_shape, k_shape, strides):
+        x = jnp.asarray(np.random.randn(*x_shape), jnp.bfloat16)
+        k = jnp.asarray(np.random.randn(*k_shape), jnp.bfloat16)
+        g = jax.jit(lambda x, k: jax.lax.conv_general_dilated(
+            x, k, strides, "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        for _ in range(3):
+            out = g(x, k)
+        float(jnp.asarray(out).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = g(x, k)
+        float(jnp.asarray(out).ravel()[0])
+        dt = (time.perf_counter() - t0) / 20
+        oh, ow = out.shape[1], out.shape[2]
+        flops = 2 * x_shape[0] * oh * ow * k_shape[0] * k_shape[1] \
+            * k_shape[2] * k_shape[3]
+        record(event=f"conv_{tag}", ms=round(dt * 1e3, 3),
+               tflops=round(flops / dt / 1e12, 2),
+               mfu=round(flops / dt / PEAK, 4))
+
+    for tag, xs, ks, st in (
+            ("stem7x7", (256, 224, 224, 3), (7, 7, 3, 64), (2, 2)),
+            ("mid3x3", (256, 28, 28, 128), (3, 3, 128, 128), (1, 1))):
+        try:  # independently: one conv failing must not drop the other
+            conv_peak(tag, xs, ks, st)
+        except Exception as e:
+            record(event=f"conv_error_{tag}",
+                   error=f"{type(e).__name__}: {e}"[:200])
+
+    # 2. batch × scan sweep on the real training step. scan amortizes the
+    # tunnel's per-dispatch round trip — the scan→MFU curve separates
+    # device throughput from dispatch latency (VERDICT r2 #2).
     best = None
-    for batch in (256, 512):
-        for scan in (1, 4, 8):
+    for batch in (128, 256, 512):
+        for scan in (1, 8, 32):
             try:
                 ips = bench_resnet(batch, warmup=2, iters=4,
                                    scan_steps=scan)
@@ -82,6 +117,31 @@ def main():
                        "img_s": round(best[0], 1)}, f)
         record(event="tuned", batch=best[1], scan=best[2],
                img_s=round(best[0], 1))
+
+        # 3. fwd-only at the winning batch: locates the residual deficit
+        # (forward conv stack vs backward) for docs/benchmarks.md
+        try:
+            from horovod_tpu.models import ResNet50
+
+            model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+            x = jnp.asarray(np.random.randn(best[1], 224, 224, 3),
+                            jnp.bfloat16)
+            variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
+            fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+            for _ in range(3):
+                out = fwd(variables, x)
+            float(jnp.asarray(out).ravel()[0])
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = fwd(variables, x)
+            float(jnp.asarray(out).ravel()[0])
+            dt = (time.perf_counter() - t0) / 10
+            ips = best[1] / dt
+            record(event="fwd_only", batch=best[1], img_s=round(ips, 1),
+                   mfu=round(ips * FWD / PEAK, 4))
+        except Exception as e:
+            record(event="fwd_only_error",
+                   error=f"{type(e).__name__}: {e}"[:200])
 
 
 if __name__ == "__main__":

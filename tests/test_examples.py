@@ -208,3 +208,12 @@ def test_elastic_and_moe_examples():
     _run([sys.executable, os.path.join(EXAMPLES, "moe_alltoall_benchmark.py"),
           "--tokens-per-chip", "64", "--d-model", "32", "--exchange-mb",
           "1"], env_extra=mesh8)
+
+
+def test_long_context_ring_attention_example():
+    """Long-context SP example: a sequence sharded over the 'sp' mesh
+    axis trains through ring attention (SURVEY.md §5.7 greenfield)."""
+    out = _run([sys.executable,
+                os.path.join(EXAMPLES, "long_context_ring_attention.py"),
+                "--seq-len", "512", "--steps", "2", "--d-model", "128"])
+    assert "tok/s" in out
