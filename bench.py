@@ -258,6 +258,9 @@ def main():
     extras = {
         "allreduce_gbps": safe(bench_eager_allreduce,
                                (1 << 20) if quick else (64 << 20)),
+        "allreduce_device_resident_gbps": safe(
+            bench_eager_allreduce, (1 << 20) if quick else (64 << 20),
+            device_resident=True),
         "allreduce_bf16_compressed_gbps": safe(
             bench_eager_allreduce, (1 << 20) if quick else (64 << 20),
             compressed=True),
@@ -278,6 +281,12 @@ def main():
         "per-chip img/s vs reference ResNet-101 example on 16x 2017 Pascal "
         "GPUs (docs/benchmarks.rst:31-41); era-mismatched hardware — read "
         "mfu for the honest utilization number")
+    if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
+        # honest metadata: this run is the forced-CPU fallback because the
+        # TPU child failed/hung (wedged tunnel) — numbers are NOT chip
+        # numbers and mfu is vs the TPU peak (i.e. meaningless here)
+        extras["fallback_cpu"] = True
+        extras["fallback_reason"] = os.environ["HVD_BENCH_FALLBACK_REASON"]
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip_ips, 2),
@@ -295,5 +304,82 @@ def _sync_int_env(name, default):
         return default
 
 
+_BENCH_CHILD = "_HVD_BENCH_CHILD"
+
+
+def _parent_main() -> int:
+    """Hang-proof wrapper (the __graft_entry__ discipline: the parent
+    NEVER touches the JAX backend — on a wedged tunnel even backend
+    probes block forever). The real benchmark runs in a timed child; if
+    that child fails or hangs, a forced-CPU child re-runs in --quick mode
+    with ``fallback_cpu`` metadata, so the round artifact documents the
+    tunnel state instead of going red with no JSON at all."""
+    import subprocess
+
+    env = dict(os.environ)
+    env[_BENCH_CHILD] = "1"
+    args = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
+    err = ""
+    # stage 1: a 120 s probe child decides whether the backend is usable
+    # at all — a wedged tunnel HANGS inside backend init (it does not
+    # raise), and burning the full bench timeout on that hang could
+    # outlast the caller's own patience
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('BENCH-PROBE-OK')"],
+            env=dict(os.environ), timeout=120,
+            capture_output=True, text=True)
+        probe_ok = "BENCH-PROBE-OK" in probe.stdout
+        if not probe_ok:
+            err = (probe.stderr or "backend probe failed")[-400:]
+    except subprocess.TimeoutExpired:
+        probe_ok = False
+        err = "backend probe hung for 120 s (wedged tunnel)"
+    if probe_ok:
+        try:
+            p = subprocess.run(args, env=env, timeout=2400,
+                               capture_output=True, text=True)
+            if p.returncode == 0 and any(
+                    ln.startswith("{") for ln in p.stdout.splitlines()):
+                sys.stdout.write(p.stdout)
+                if p.stderr:
+                    sys.stderr.write(p.stderr[-2000:])
+                return 0
+            err = (p.stderr or p.stdout or "bench child failed")[-400:]
+        except subprocess.TimeoutExpired:
+            err = "TPU bench child timed out after 2400 s"
+    sys.stderr.write(f"bench: TPU run failed, falling back to CPU: {err}\n")
+    env["JAX_PLATFORMS"] = "cpu"
+    for trigger in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+        env.pop(trigger, None)
+    env["HVD_BENCH_FALLBACK_REASON"] = err.replace("\n", " ")[-300:]
+    # CPU smoke sizes: the fallback's job is a well-formed, honestly
+    # labeled JSON line, not throughput — override any user sizing meant
+    # for the chip
+    env["HVD_BENCH_BATCH"] = "8"
+    env["HVD_BENCH_SCAN_STEPS"] = "1"
+    if "--quick" not in args:
+        args = args + ["--quick"]
+    try:
+        p = subprocess.run(args, env=env, timeout=2400,
+                           capture_output=True, text=True)
+        sys.stdout.write(p.stdout)
+        if p.stderr:
+            sys.stderr.write(p.stderr[-2000:])
+        return p.returncode
+    except subprocess.TimeoutExpired:
+        # last resort: still emit one well-formed JSON artifact
+        print(json.dumps({
+            "metric": "resnet50_images_per_sec_per_chip", "value": 0.0,
+            "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.0,
+            "extras": {"error": "TPU and CPU fallback both timed out",
+                       "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"]},
+        }))
+        return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get(_BENCH_CHILD) == "1":
+        sys.exit(main())
+    sys.exit(_parent_main())

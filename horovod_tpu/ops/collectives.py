@@ -195,14 +195,17 @@ def _global_row_array(ps: ProcessSet, local):
         )
     sharding = NamedSharding(mesh, P(PROC_AXIS))
     gshape = (ps.cross_size,) + tuple(local.shape)
-    if isinstance(local, jax.Array):
-        row = jnp.expand_dims(local, 0)
-        shards = [jax.device_put(row, d)
-                  for d in sharding.addressable_devices]
-        return jax.make_array_from_single_device_arrays(
-            gshape, sharding, shards)
-    return jax.make_array_from_process_local_data(
-        sharding, local[None], gshape)
+    # one path for both input kinds, built on EXPLICIT device_put: a
+    # jax.Array row replicates device-to-device (no host round-trip); a
+    # numpy row uploads host-to-device — and either way the transfers are
+    # explicit, so user code under jax.transfer_guard("disallow") can
+    # still issue eager collectives
+    row = (jnp.expand_dims(local, 0) if isinstance(local, jax.Array)
+           else local[None])
+    shards = [jax.device_put(row, d)
+              for d in sharding.addressable_devices]
+    return jax.make_array_from_single_device_arrays(
+        gshape, sharding, shards)
 
 
 def _replicated(ps: ProcessSet):
@@ -321,15 +324,21 @@ def _eager_allgather(x, ps: ProcessSet):
     """Ragged-first-dim allgather (reference AllgatherOp displacement math,
     collective_operations.h:141-205): pad to max dim0 on device, compact on
     host."""
-    xl = _to_local_np(x)
+    xl = _to_local(x)
     nproc = ps.cross_size
     if nproc == 1:
         return jnp.asarray(xl)
-    # exchange first-dim sizes
-    sizes = _to_local_np(
+    # exchange first-dim sizes (one explicit 8-byte device_get per call —
+    # the raggedness decision is Python control flow)
+    sizes = np.asarray(jax.device_get(
         _eager_allgather_fixed(np.array([xl.shape[0]], np.int64), ps)
-    ).reshape(-1)
+    )).reshape(-1)
     maxn = int(sizes.max())
+    if int(sizes.min()) == maxn:
+        # even case (the overwhelmingly common one): no pad/compact —
+        # a device-resident payload stays on device
+        return _eager_allgather_fixed(xl, ps)
+    xl = _to_local_np(xl)  # ragged: host-side pad + compact
     pad = np.zeros((maxn,) + xl.shape[1:], xl.dtype)
     pad[: xl.shape[0]] = xl
     gathered = _to_local_np(_eager_allgather_fixed(pad, ps))
@@ -386,7 +395,7 @@ def _eager_allgather_fixed(xl: np.ndarray, ps: ProcessSet):
 
 
 def _eager_broadcast(x, root_rank: int, ps: ProcessSet):
-    xl = _to_local_np(x)
+    xl = _to_local(x)  # device-resident inputs stay on device
     if ps.cross_size == 1:
         return jnp.asarray(xl)
     # map root chip rank -> owning process row

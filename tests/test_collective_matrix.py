@@ -142,6 +142,18 @@ MATRIX_WORKER = textwrap.dedent("""
         expect = (np.arange(8, dtype=np.float32) * 2)[r * 4:(r + 1) * 4]
         assert np.allclose(out.astype(np.float32), expect), (dt, out)
 
+    # --- even-case allgather with a device-resident payload: the fast
+    # path (no pad/compact) keeps the payload on device; only the 8-byte
+    # size exchange and result fetch are explicit transfers -------------
+    xd = jnp.ones((4, 2), jnp.float32) * (r + 1)
+    jax.block_until_ready(xd)
+    with jax.transfer_guard("disallow"):
+        ev = hvd.allgather(xd)
+        jax.block_until_ready(ev)
+    ev = np.asarray(ev)
+    assert ev.shape == (8, 2)
+    assert np.allclose(ev[:4], 1.0) and np.allclose(ev[4:], 2.0), ev
+
     # --- cross-process subset process set (1 chip from each process) ------
     ps = hvd.add_process_set([0, 2], name="m.span")
     out = np.asarray(hvd.synchronize(hvd.allreduce_async(

@@ -233,9 +233,15 @@ def test_eager_allreduce_device_resident_no_host_copy():
     with jax.transfer_guard("disallow"):
         out = hvd.allreduce(x, average=True)
         outs = hvd.grouped_allreduce([x, x2], op=hvd.Sum)
-        jax.block_until_ready((out, outs))
+        outg = hvd.allgather(x.reshape(64, 64))
+        outb = hvd.broadcast(x, root_rank=0)
+        jax.block_until_ready((out, outs, outg, outb))
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
     np.testing.assert_allclose(np.asarray(outs[1]), np.asarray(x) * 2)
+    # single process: eager allgather over cross_size==1 is identity
+    np.testing.assert_allclose(np.asarray(outg),
+                               np.asarray(x).reshape(64, 64))
+    np.testing.assert_allclose(np.asarray(outb), np.asarray(x))
 
 
 def test_eager_allreduce_numpy_input_still_works():
