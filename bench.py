@@ -364,19 +364,23 @@ def _parent_main() -> int:
     try:
         p = subprocess.run(args, env=env, timeout=2400,
                            capture_output=True, text=True)
-        sys.stdout.write(p.stdout)
-        if p.stderr:
-            sys.stderr.write(p.stderr[-2000:])
-        return p.returncode
+        if any(ln.startswith("{") for ln in p.stdout.splitlines()):
+            sys.stdout.write(p.stdout)
+            if p.stderr:
+                sys.stderr.write(p.stderr[-2000:])
+            return 0
+        fb_err = "CPU fallback produced no JSON: " \
+            + (p.stderr or "")[-300:]
     except subprocess.TimeoutExpired:
-        # last resort: still emit one well-formed JSON artifact
-        print(json.dumps({
-            "metric": "resnet50_images_per_sec_per_chip", "value": 0.0,
-            "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.0,
-            "extras": {"error": "TPU and CPU fallback both timed out",
-                       "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"]},
-        }))
-        return 0
+        fb_err = "TPU and CPU fallback both timed out"
+    # last resort: one well-formed JSON artifact, whatever happened
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip", "value": 0.0,
+        "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.0,
+        "extras": {"error": fb_err.replace("\n", " "),
+                   "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"]},
+    }))
+    return 0
 
 
 if __name__ == "__main__":

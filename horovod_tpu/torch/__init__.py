@@ -88,27 +88,45 @@ LOG = logging.getLogger("horovod_tpu")
 def _to_np(t: torch.Tensor) -> np.ndarray:
     if t.dtype in (torch.float64, torch.int64):
         warn_64bit_narrowing(t.dtype)
-    return t.detach().cpu().numpy()
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        # torch cannot hand bf16 to numpy directly; reinterpret the bits
+        # (torch bf16 and ml_dtypes.bfloat16 share the layout) so the
+        # wire carries true bf16, not an f32 upcast
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _np_from_wire(result, copy: bool = True) -> torch.Tensor:
+    """numpy (possibly ml_dtypes.bfloat16, possibly a read-only view of
+    the shared fused buffer) → torch tensor.
+
+    ``copy=True`` hands the caller a writable copy (in-place use — grad
+    mutation, zero_grad — must not corrupt fused-buffer neighbors);
+    ``copy=False`` is for paths that only READ the intermediate before
+    ``target.copy_``, copying just when numpy hands back a read-only
+    view (from_numpy would warn)."""
+    arr = np.asarray(result)
+    bf16 = arr.dtype.name == "bfloat16"
+    if bf16:  # torch bf16 and ml_dtypes.bfloat16 share the bit layout
+        arr = arr.view(np.uint16)
+    if copy or not arr.flags.writeable:
+        arr = np.array(arr)
+    out = torch.from_numpy(arr)
+    return out.view(torch.bfloat16) if bf16 else out
 
 
 def _np_to_torch(result, dtype=None) -> torch.Tensor:
-    # np.array (not asarray): collective results can be read-only views of
-    # the runtime's shared fused buffer — hand the caller a writable copy so
-    # in-place use (grad mutation, zero_grad) can't corrupt neighbors.
-    out = torch.from_numpy(np.array(result))
+    out = _np_from_wire(result)
     return out.to(dtype) if dtype is not None else out
 
 
 def _result_tensor(handle: int, result) -> torch.Tensor:
     target, dtype = _handle_meta.pop(handle, (None, None))
     if target is not None:
-        # In-place path only *reads* the intermediate, but from_numpy on a
-        # read-only view (results can be views of the shared fused buffer)
-        # emits a UserWarning per collective — copy only when needed.
-        arr = np.asarray(result)
-        if not arr.flags.writeable:
-            arr = arr.copy()
-        out = torch.from_numpy(arr)
+        out = _np_from_wire(result, copy=False)
         target.copy_(out.to(target.dtype).reshape(target.shape))
         return target
     return _np_to_torch(result, dtype)

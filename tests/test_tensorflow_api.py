@@ -20,11 +20,25 @@ def setup_module():
 
 
 def test_allreduce_dtypes_roundtrip():
-    for dtype in (tf.float32, tf.float64, tf.int32):
+    # reference test/parallel/test_tensorflow.py dtype sweep
+    for dtype in (tf.float32, tf.float64, tf.int32, tf.int64, tf.float16,
+                  tf.bfloat16, tf.uint8):
         t = tf.cast(tf.range(8), dtype)
         out = hvd.allreduce(t, op=hvd.Sum, name=f"tf.rt.{dtype.name}")
         assert out.dtype == dtype
-        np.testing.assert_allclose(out.numpy(), t.numpy())
+        np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(),
+                                   tf.cast(t, tf.float32).numpy())
+
+
+def test_allgather_broadcast_dtypes():
+    for dtype in (tf.float32, tf.bfloat16, tf.uint8, tf.bool):
+        t = tf.reshape(tf.cast(tf.range(6) % 2, dtype), (3, 2))
+        g = hvd.allgather(t, name=f"tf.ag.{dtype.name}")
+        assert g.dtype == dtype
+        b = hvd.broadcast(t, root_rank=0, name=f"tf.bc.{dtype.name}")
+        assert b.dtype == dtype
+        np.testing.assert_allclose(tf.cast(b, tf.float32).numpy(),
+                                   tf.cast(t, tf.float32).numpy())
 
 
 def test_allreduce_average_and_scales():

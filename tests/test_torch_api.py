@@ -9,11 +9,23 @@ import horovod_tpu.torch as hvd
 
 
 def test_allreduce_roundtrip_dtypes():
-    for dtype in (torch.float32, torch.float64, torch.int32):
-        t = torch.arange(8, dtype=dtype)
+    # reference test/parallel/test_torch.py dtype sweep: every wire dtype
+    # (incl. narrowed 64-bit and sub-f32) round-trips with its own dtype
+    for dtype in (torch.float32, torch.float64, torch.int32, torch.int64,
+                  torch.float16, torch.bfloat16, torch.uint8):
+        t = torch.arange(8).to(dtype)
         out = hvd.allreduce(t, op=hvd.Sum, name=f"t.torch.{dtype}")
-        assert torch.equal(out, t)
+        assert torch.equal(out, t), (dtype, out)
         assert out.dtype == dtype
+
+
+def test_allgather_broadcast_dtypes():
+    for dtype in (torch.float32, torch.bfloat16, torch.uint8, torch.bool):
+        t = (torch.arange(6) % 2).to(dtype).reshape(3, 2)
+        g = hvd.allgather(t, name=f"t.torch.ag.{dtype}")
+        assert g.dtype == dtype and torch.equal(g, t)
+        b = hvd.broadcast(t, root_rank=0, name=f"t.torch.bc.{dtype}")
+        assert b.dtype == dtype and torch.equal(b, t)
 
 
 def test_allreduce_inplace_and_average():
