@@ -73,8 +73,10 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
     two modes converge.
     """
     n = hvd.size()
+    s2d = os.environ.get("HVD_BENCH_S2D", "0") == "1"
     model = (model_fn or (lambda: ResNet50(num_classes=num_classes,
-                                           dtype=jnp.bfloat16)))()
+                                           dtype=jnp.bfloat16,
+                                           space_to_depth=s2d)))()
     rng = jax.random.PRNGKey(0)
     batch = per_chip_batch * n
     images = jnp.asarray(
@@ -370,7 +372,7 @@ def _parent_main() -> int:
                 sys.stderr.write(p.stderr[-2000:])
             return 0
         fb_err = "CPU fallback produced no JSON: " \
-            + (p.stderr or "")[-300:]
+            + (p.stderr or p.stdout or "")[-300:]
     except subprocess.TimeoutExpired:
         fb_err = "TPU and CPU fallback both timed out"
     # last resort: one well-formed JSON artifact, whatever happened

@@ -118,6 +118,24 @@ def main():
         record(event="tuned", batch=best[1], scan=best[2],
                img_s=round(best[0], 1))
 
+        # 2b. space-to-depth stem at the winning config (MLPerf TPU stem:
+        # the 7x7/s2 conv on 3 channels lights 3 of 128 MXU lanes; s2d
+        # lights 12). If it wins, record it so bench.py can adopt it.
+        try:
+            from horovod_tpu.models import ResNet50
+
+            ips = bench_resnet(
+                best[1], warmup=2, iters=4, scan_steps=best[2],
+                model_fn=lambda: ResNet50(num_classes=1000,
+                                          dtype=jnp.bfloat16,
+                                          space_to_depth=True))
+            record(event="resnet_s2d", batch=best[1], scan=best[2],
+                   img_s=round(ips, 1),
+                   mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
+        except Exception as e:
+            record(event="resnet_s2d_error",
+                   error=f"{type(e).__name__}: {e}"[:200])
+
         # 3. fwd-only at the winning batch: locates the residual deficit
         # (forward conv stack vs backward) for docs/benchmarks.md
         try:

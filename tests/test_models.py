@@ -109,3 +109,26 @@ def test_vit_forward_and_grad():
     leaves = jax.tree.leaves(g)
     assert leaves and all(np.all(np.isfinite(np.asarray(l, np.float32)))
                           for l in leaves)
+
+
+def test_resnet50_space_to_depth_stem():
+    """MLPerf-style TPU stem: 2x2 space-to-depth + 4x4/s1 conv produces
+    the same downstream dims as the 7x7/s2 stem (same head shapes, same
+    parameter count downstream of the stem)."""
+    std = ResNet50(num_classes=10, dtype=jnp.float32)
+    s2d = ResNet50(num_classes=10, dtype=jnp.float32, space_to_depth=True)
+    x = jnp.ones((2, 64, 64, 3))
+    v1 = std.init(jax.random.PRNGKey(0), x, train=False)
+    v2 = s2d.init(jax.random.PRNGKey(0), x, train=False)
+    y1 = std.apply(v1, x, train=False)
+    y2 = s2d.apply(v2, x, train=False)
+    assert y1.shape == y2.shape == (2, 10)
+    # only the stem conv differs: 7x7x3x64 vs 4x4x12x64
+    p1, p2 = v1["params"], v2["params"]
+    assert p1["conv_init"]["kernel"].shape == (7, 7, 3, 64)
+    assert p2["conv_init_s2d"]["kernel"].shape == (4, 4, 12, 64)
+    rest1 = {k: v for k, v in p1.items() if k != "conv_init"}
+    rest2 = {k: v for k, v in p2.items() if k != "conv_init_s2d"}
+    shapes1 = jax.tree.map(lambda a: a.shape, rest1)
+    shapes2 = jax.tree.map(lambda a: a.shape, rest2)
+    assert shapes1 == shapes2
