@@ -153,6 +153,8 @@ def reducescatter_async(tensor, name: Optional[str] = None, *,
 def grouped_allreduce_async(tensors, average: Optional[bool] = None,
                             name: Optional[str] = None, *,
                             op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
                             process_set: Optional[ProcessSet] = None) -> list[int]:
     """Enqueue a group in one shot; the cycle loop fuses them into a single
     flat collective (reference grouped allreduce + GroupTable)."""
@@ -160,7 +162,10 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
     # "grouped_allreduce.noname.<n>"): two concurrently pending unnamed
     # groups must not collide on the in-flight name guard
     base = name or _default_name("grouped_allreduce", tensors)
-    return [allreduce_async(t, average, f"{base}.{i}", op=op, process_set=process_set)
+    return [allreduce_async(t, average, f"{base}.{i}", op=op,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor,
+                            process_set=process_set)
             for i, t in enumerate(tensors)]
 
 

@@ -186,3 +186,29 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
         self._offset = 0
         self.state.batch = 0
         self.state.epoch = epoch + 1
+
+
+class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+    """Save-best-only checkpoint whose filepath the caller (e.g. the Spark
+    Keras estimator) assigns before fit (reference keras/callbacks.py:151
+    — a ModelCheckpoint pinned to save_best_only=True with filepath left
+    unset so a forgotten assignment fails loudly, not silently into the
+    CWD)."""
+
+    def __init__(self, filepath=None, monitor="val_loss", verbose: int = 0,
+                 mode: str = "auto", save_freq="epoch"):
+        # Keras validates the suffix at construction; a placeholder rides
+        # through and is nulled so an unassigned path fails loudly at save
+        super().__init__(filepath=filepath or "unassigned.keras",
+                         monitor=monitor, verbose=verbose,
+                         save_best_only=True, save_weights_only=False,
+                         mode=mode, save_freq=save_freq)
+        if not filepath:
+            self.filepath = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.filepath:
+            raise ValueError(
+                "BestModelCheckpoint.filepath was never assigned (the "
+                "estimator sets it before fit)")
+        return super().on_epoch_end(epoch, logs)

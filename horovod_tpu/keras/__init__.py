@@ -52,6 +52,43 @@ def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
         average_aggregated_gradients=average_aggregated_gradients)
 
 
+def allreduce(value, name=None, average=True, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Reference keras/__init__.py allreduce: reduce a Keras/numpy value
+    across workers, returned as numpy (Keras 3's universal currency)."""
+    import numpy as np
+
+    out = _core.synchronize(_core.allreduce_async(
+        np.asarray(value), average, name,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+    return np.asarray(out)
+
+
+def allgather(value, name=None):
+    """Reference keras/__init__.py allgather (dim-0 concat)."""
+    import numpy as np
+
+    return np.asarray(_core.synchronize(
+        _core.allgather_async(np.asarray(value), name)))
+
+
+def broadcast(value, root_rank: int = 0, name=None):
+    """Reference keras/__init__.py broadcast."""
+    import numpy as np
+
+    return np.asarray(_core.synchronize(
+        _core.broadcast_async(np.asarray(value), root_rank, name)))
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """TF1 global-collection broadcast (reference keras/__init__.py) —
+    gated: Keras 3 has no global variables collection."""
+    from horovod_tpu._keras import broadcast_global_variables as _impl
+
+    return _impl(None, root_rank)
+
+
 def broadcast_variables(variables, root_rank: int = 0):
     import numpy as np
 

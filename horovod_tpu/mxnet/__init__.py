@@ -116,6 +116,40 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     return _wrap(out, tensor), recv_out
 
 
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None, priority: int = 0,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Reference mxnet/mpi_ops.py grouped_allreduce: reduce a list as one
+    fused logical op — through the async runtime like every other
+    collective here (name guard + queue fusion semantics)."""
+    hs = _core.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], average, name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return [_wrap(_core.synchronize(h), t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allreduce_(tensors, average: bool = True,
+                       name: Optional[str] = None, priority: int = 0,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0):
+    outs = grouped_allreduce(tensors, average, name, priority,
+                             prescale_factor, postscale_factor)
+    for t, o in zip(tensors, outs):
+        t[:] = o
+    return tensors
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    """Reference mxnet/functions.py allgather_object."""
+    return _core.allgather_object(obj)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Reference mxnet/functions.py broadcast_object."""
+    return _core.broadcast_object(obj, root_rank=root_rank)
+
+
 def broadcast_parameters(params, root_rank: int = 0):
     """Gluon ParameterDict or plain dict of arrays (reference
     mxnet/__init__.py:191)."""

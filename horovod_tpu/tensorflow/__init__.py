@@ -61,6 +61,7 @@ from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object,
+    broadcast_global_variables,
     broadcast_object,
     broadcast_object_fn,
     broadcast_variables,

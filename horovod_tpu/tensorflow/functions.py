@@ -55,3 +55,15 @@ def broadcast_object_fn(root_rank: int = 0, session=None, name=None,
 
 def allgather_object(obj, session=None, name=None, process_set=None):
     return _core.allgather_object(obj, process_set=process_set)
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """Reference functions.py broadcast_global_variables — gated. The TF1
+    global-variables collection only exists in graph-session mode, whose
+    data plane this runtime does not implement (variables there have no
+    eager values to ship); TF2 eager has no global collection at all.
+    Either way the supported idiom is explicit variables."""
+    raise RuntimeError(
+        "TF1 graph-mode global-variable broadcast is not supported on "
+        "this runtime; use hvd.broadcast_variables(model.variables, "
+        "root_rank) after building the model")

@@ -457,6 +457,13 @@ class _DistributedMixin:
                 reduced, ctx).reshape(p.grad.shape).to(p.grad.dtype)
         self._handles.clear()
 
+    def set_backward_passes_per_step(self, passes: int):
+        """Change the local gradient-accumulation window (reference
+        optimizer.py set_backward_passes_per_step); resets pass counters."""
+        self._bpps = int(passes)
+        for p in self._passes:
+            self._passes[p] = 0
+
     @contextlib.contextmanager
     def skip_synchronize(self):
         """reference optimizer.py skip_synchronize: suppress the implicit

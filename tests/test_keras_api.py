@@ -218,3 +218,33 @@ def test_keras_elastic_mid_epoch_batch_resume():
     # exactly-once: every (epoch, batch) pair appears once, in order
     expect = [(e, b) for e in range(EPOCHS) for b in range(STEPS)]
     assert processed == expect, processed[:10]
+
+
+def test_keras_tensor_functions_and_best_checkpoint(tmp_path):
+    """Reference keras surface: hvd.allreduce/allgather/broadcast on
+    values, BestModelCheckpoint (save_best_only pinned), and the gated
+    TF1 broadcast_global_variables."""
+    import keras
+    import numpy as np
+
+    out = hvd.allreduce(np.full((4,), 2.0, np.float32), name="k.ar")
+    np.testing.assert_allclose(out, 2.0)
+    g = hvd.allgather(np.ones((2, 2), np.float32), name="k.ag")
+    assert g.shape == (2, 2)
+    b = hvd.broadcast(np.arange(3.0), 0, name="k.bc")
+    np.testing.assert_allclose(b, np.arange(3.0))
+    with pytest.raises(NotImplementedError):
+        hvd.broadcast_global_variables(0)
+
+    with pytest.raises(ValueError, match="never assigned"):
+        unset = hvd.callbacks.BestModelCheckpoint(monitor="loss")
+        unset.on_epoch_end(0, {"loss": 1.0})
+    cb = hvd.callbacks.BestModelCheckpoint(
+        filepath=str(tmp_path / "best.keras"), monitor="loss")
+    assert cb.save_best_only
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = x @ np.ones((4, 1), np.float32)
+    model = keras.Sequential([keras.Input((4,)), keras.layers.Dense(1)])
+    model.compile(optimizer="sgd", loss="mse")
+    model.fit(x, y, epochs=2, batch_size=16, verbose=0, callbacks=[cb])
+    assert (tmp_path / "best.keras").exists()

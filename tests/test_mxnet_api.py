@@ -94,3 +94,18 @@ def test_distributed_trainer_gated_without_mxnet():
     assert hvd_mx.MXNET_AVAILABLE is False
     with pytest.raises(ImportError, match="mxnet"):
         hvd_mx.DistributedTrainer({}, "sgd")
+
+
+def test_grouped_and_object_collectives():
+    """Reference mxnet surface: grouped_allreduce(_) and the object
+    collectives (functions.py)."""
+    a = np.arange(4, dtype=np.float32)
+    b = np.ones((2, 2), np.float32)
+    outs = hvd_mx.grouped_allreduce([a, b], average=True)
+    np.testing.assert_allclose(outs[0], a)
+    np.testing.assert_allclose(outs[1], b)
+    ts = [np.arange(4, dtype=np.float32), np.ones((2, 2), np.float32)]
+    hvd_mx.grouped_allreduce_(ts, average=True)
+    np.testing.assert_allclose(ts[0], np.arange(4))
+    assert hvd_mx.allgather_object({"r": hvd_mx.rank()}) == [{"r": 0}]
+    assert hvd_mx.broadcast_object((1, "x")) == (1, "x")

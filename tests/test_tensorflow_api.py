@@ -286,3 +286,10 @@ def test_allgather_broadcast_alltoall_gradients():
         loss = tf.reduce_sum(out * tf.constant([[1.0, 2.0], [3.0, 4.0]]))
     dx = tape.gradient(loss, x)
     np.testing.assert_allclose(dx.numpy(), [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_broadcast_global_variables_tf2_gating():
+    """TF1 global-collection broadcast raises the TF2 guidance when no
+    collection exists (reference functions.py surface, honestly gated)."""
+    with pytest.raises(RuntimeError, match="broadcast_variables"):
+        hvd.broadcast_global_variables(0)

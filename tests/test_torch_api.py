@@ -324,3 +324,19 @@ def test_64bit_narrowing_warns_once(caplog):
                       op=hvd.Sum, name="t.torch.i64warn")
     hits = [r for r in caplog.records if "32-bit" in r.getMessage()]
     assert len(hits) == 1, [r.getMessage() for r in hits]
+
+
+def test_set_backward_passes_per_step():
+    """reference optimizer.py set_backward_passes_per_step: the
+    accumulation window is adjustable after construction."""
+    model = torch.nn.Linear(3, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=4)
+    assert opt._bpps == 4
+    opt.set_backward_passes_per_step(1)
+    assert opt._bpps == 1
+    out = model(torch.randn(2, 3)).sum()
+    out.backward()
+    opt.step()  # bpps=1: hooks fire + sync immediately, no hang
