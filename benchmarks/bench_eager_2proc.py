@@ -28,7 +28,8 @@ def main_parent():
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     from horovod_tpu.runner.launch import run_commandline
 
-    return run_commandline(["-np", "2", sys.executable,
+    np_ = os.environ.get("HVD_BENCH_NP", "2")
+    return run_commandline(["-np", np_, sys.executable,
                             os.path.abspath(__file__)])
 
 
@@ -47,6 +48,7 @@ def main_worker():
 
     hvd.init()
     r = hvd.cross_rank()
+    nproc = hvd.cross_size()
     rows = []
 
     def sweep(nbytes, mode, iters=8):
@@ -89,7 +91,7 @@ def main_worker():
     if r == 0:
         result = {"rows": rows, "negotiation": stats}
         out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "eager_allreduce_2proc.json")
+                                f"eager_allreduce_{nproc}proc.json")
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1)
         print("BENCH-EAGER-RESULT " + json.dumps(result))

@@ -206,9 +206,17 @@ class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
         if not filepath:
             self.filepath = None
 
-    def on_epoch_end(self, epoch, logs=None):
+    def _require_filepath(self):
         if not self.filepath:
             raise ValueError(
                 "BestModelCheckpoint.filepath was never assigned (the "
                 "estimator sets it before fit)")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._require_filepath()
         return super().on_epoch_end(epoch, logs)
+
+    def on_train_batch_end(self, batch, logs=None):
+        # integer save_freq saves on the batch path too
+        self._require_filepath()
+        return super().on_train_batch_end(batch, logs)
