@@ -34,9 +34,20 @@ __all__ = [
 
 def _reinitialize():
     """Re-init the collective runtime after a failure (reference
-    elastic.py:159 _reset: shutdown + init)."""
+    elastic.py:159 _reset: shutdown + init).
+
+    Bumps the controller generation (HOROVOD_ELASTIC_GEN): the new
+    lockstep gets a fresh KV namespace so it can never read the dead
+    generation's negotiation rounds (see ops/controller.py protocol
+    notes). Ranks that miss a reinit starve on their old scope, hit the
+    response timeout, and reinit too — converging generations."""
+    import os
+
     from ..common import context as ctx_mod
     from ..ops.collectives import clear_eager_cache
+
+    os.environ["HOROVOD_ELASTIC_GEN"] = str(
+        int(os.environ.get("HOROVOD_ELASTIC_GEN", "0")) + 1)
 
     ctx_mod.shutdown()
     clear_eager_cache()

@@ -23,17 +23,20 @@ TPU-shaped differences:
   derives identical fusion groups from it locally (same deterministic
   algorithm), replacing FuseResponses' look-ahead (:777-849).
 
-Protocol (round r, scope ``ctl``):
-  worker k:  PUT  ctl/r{r}/ready/{k}   = JSON {"e": [[name, sig], ...],
-                                               "j": joined?}
+Protocol (round r; P = ctl/e{epoch}g{gen}, the generation prefix — epoch
+from the elastic driver's incarnation, gen from in-process reinits):
+  worker k:  PUT  P/r{r}/ready/{k}   = JSON {"e": [[name, sig], ...],
+                                             "j": joined?}
              (or the 1-byte SAME_AS_LAST marker when identical to round r-1)
-  rank 0:    GET  ctl/r{r}/ready/* (all k) → count/validate/order
-             PUT  ctl/r{r}/resp        = JSON {"ready": [names...],
-                                               "sigs": {name: sig},
-                                               "errors": {name: msg},
-                                               "join_done": last_rank|null}
-  worker k:  GET  ctl/r{r}/resp (blocking) → execute / fail
-Rounds advance in lockstep; scope r-2 is garbage-collected by rank 0.
+  rank 0:    GET  P/r{r}/ready/* (all k) → count/validate/order
+             PUT  P/r{r}/resp        = JSON {"ready": [names...],
+                                             "sigs": {name: sig},
+                                             "errors": {name: msg},
+                                             "join_done": last_rank|null}
+  worker k:  GET  P/r{r}/resp (blocking) → execute / fail
+Rounds advance in lockstep; scope r-2 is garbage-collected by rank 0, and
+a starting coordinator purges every dead generation under ctl/ (its own
+prefix excluded).
 
 Join semantics (reference JoinOp, collective_operations.h:271 +
 global_state.h:107-111 "joined ranks contribute zeros"): a joined rank keeps
@@ -48,10 +51,33 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from typing import Optional
 
 LOG = logging.getLogger("horovod_tpu")
+
+
+def _ctl_prefix() -> str:
+    """Namespace for this controller generation's rounds.
+
+    Two components: the elastic incarnation (HOROVOD_ELASTIC_EPOCH —
+    bumped by the driver on restart-based recovery) and the in-process
+    reinit generation (HOROVOD_ELASTIC_GEN — bumped by
+    elastic._reinitialize on HorovodInternalError recovery without a
+    relaunch). A new lockstep must never read a dead generation's rounds:
+    its ctl/.../r0 keys are still in the launcher's store and a stale
+    `resp` silently desyncs the new world (found by the end-to-end
+    crash-restart test). Ranks whose generation counters diverge starve
+    (nobody serves their scope), hit their response timeout, and reinit
+    again — converging on the highest generation.
+    """
+    return (f"ctl/e{os.environ.get('HOROVOD_ELASTIC_EPOCH', '0')}"
+            f"g{os.environ.get('HOROVOD_ELASTIC_GEN', '0')}")
+
+
+def _ctl_scope(r: int) -> str:
+    return f"{_ctl_prefix()}/r{r}"
 
 
 def entry_signature(entry) -> list:
@@ -150,10 +176,10 @@ class KVController:
                 self.fast_rounds += 1
             else:
                 wire = payload
-            self.client.put(f"ctl/r{r}", f"ready/{self.rank}", wire)
+            self.client.put(_ctl_scope(r), f"ready/{self.rank}", wire)
             self.bytes_sent += len(wire)
             self._last_payload = payload
-            resp = json.loads(self.client.get(f"ctl/r{r}", "resp",
+            resp = json.loads(self.client.get(_ctl_scope(r), "resp",
                                               timeout=self.poll_timeout))
         except Exception:
             self.broken = True
@@ -250,7 +276,7 @@ class _Coordinator(threading.Thread):
         # SAME_AS_LAST decode cache is stale on both sides: drop it here
         # and tell workers to resend full payloads next round
         self._last_submission.clear()
-        self.client.put(f"ctl/r{r}", "resp",
+        self.client.put(_ctl_scope(r), "resp",
                         json.dumps({"ready": [], "errors": errors,
                                     "invalidate": True}).encode())
 
@@ -266,7 +292,7 @@ class _Coordinator(threading.Thread):
         while missing and not self._stop_evt.is_set():
             for k in sorted(missing):
                 try:
-                    got[k] = self.client.get(f"ctl/r{r}", f"ready/{k}",
+                    got[k] = self.client.get(_ctl_scope(r), f"ready/{k}",
                                              timeout=self.POLL_TIMEOUT_S)
                     missing.discard(k)
                 except Exception:
@@ -282,6 +308,14 @@ class _Coordinator(threading.Thread):
         return got if not missing else None
 
     def run(self):
+        try:
+            # GC every dead generation's rounds (crashed incarnations and
+            # pre-reinit lockstep leftovers accumulate in the launcher's
+            # store otherwise); the exclusion keeps fresh keys that fast
+            # workers of THIS generation may already have published
+            self.client.delete_prefix("ctl/", exclude=_ctl_prefix() + "/")
+        except Exception:
+            pass  # older store without DELETE prefix support
         r = 0
         resp_published = False
         while not self._stop_evt.is_set():
@@ -335,14 +369,14 @@ class _Coordinator(threading.Thread):
                     self.errors.pop(n, None)
                     self._first_seen.pop(n, None)
                     self._stall_warned.discard(n)
-                self.client.put(f"ctl/r{r}", "resp",
+                self.client.put(_ctl_scope(r), "resp",
                                 json.dumps({"ready": ready,
                                             "sigs": sigs,
                                             "errors": errors,
                                             "join_done": join_done}).encode())
                 resp_published = True
                 if r >= 2:
-                    self.client.delete_scope(f"ctl/r{r - 2}")
+                    self.client.delete_scope(_ctl_scope(r - 2))
                 r += 1
             except Exception as e:
                 if self._stop_evt.is_set():
@@ -363,7 +397,7 @@ class _Coordinator(threading.Thread):
         payload = json.dumps({"ready": [], "errors": errors,
                               "abort": msg, "invalidate": True}).encode()
         try:
-            self.client.put(f"ctl/r{r}", "resp", payload)
+            self.client.put(_ctl_scope(r), "resp", payload)
         except Exception:
             pass  # store unreachable: workers fall back to their timeout
 

@@ -64,9 +64,12 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         store = self.server.store  # type: ignore[attr-defined]
+        exclude = self.headers.get("X-Exclude-Prefix")
         with store.cond:
             prefix = self._key()
             for k in [k for k in store.data if k.startswith(prefix)]:
+                if exclude and k.startswith(exclude):
+                    continue  # live namespace: a GC sweep must not race it
                 del store.data[k]
         self.send_response(200)
         self.send_header("Content-Length", "0")
@@ -123,4 +126,13 @@ class KVStoreClient:
 
     def delete_scope(self, scope: str):
         req = Request(f"{self.base}/{scope}/", method="DELETE")
+        urlopen(req, timeout=30).read()
+
+    def delete_prefix(self, prefix: str, exclude: Optional[str] = None):
+        """Delete every key under ``prefix`` except those under
+        ``exclude`` (stale-generation GC that must not race the live
+        namespace's fresh keys)."""
+        headers = {"X-Exclude-Prefix": exclude} if exclude else {}
+        req = Request(f"{self.base}/{prefix}", method="DELETE",
+                      headers=headers)
         urlopen(req, timeout=30).read()
