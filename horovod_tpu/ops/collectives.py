@@ -218,13 +218,17 @@ def _to_local_np(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def is_device_resident(x) -> bool:
+    """True for a committed, fully-addressable jax.Array — the inputs the
+    eager paths keep on device instead of round-tripping the host."""
+    return isinstance(x, jax.Array) and x.is_fully_addressable
+
+
 def _to_local(x):
-    """Like ``_to_local_np`` but keeps a fully-addressable jax.Array on
+    """Like ``_to_local_np`` but keeps a device-resident jax.Array on
     device (the eager allreduce hot path must not round-trip gradients
     through the host when they already live on the chips)."""
-    if isinstance(x, jax.Array) and x.is_fully_addressable:
-        return x
-    return _to_local_np(x)
+    return x if is_device_resident(x) else _to_local_np(x)
 
 
 def _hierarchical_enabled(kind: str) -> bool:

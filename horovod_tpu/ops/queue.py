@@ -454,16 +454,13 @@ class BackgroundRuntime:
                 for n in names:
                     self.timeline.start_activity(n, "FUSED_ALLREDUCE")
             try:
-                import jax as _jax
                 import jax.numpy as _jnp
 
                 # device-resident chunk: fuse on device (jnp.concatenate)
                 # instead of the host fusion buffer — gradients that
                 # already live in HBM never round-trip through the host
                 # (reference NCCL path reduces the GPU buffer in place)
-                on_dev = all(isinstance(e.tensor, _jax.Array)
-                             and e.tensor.is_fully_addressable
-                             for e in chunk)
+                on_dev = all(C.is_device_resident(e.tensor) for e in chunk)
                 if on_dev:
                     arrs = [e.tensor for e in chunk]
                     flats = [_jnp.ravel(a) for a in arrs]
