@@ -219,8 +219,8 @@ def _to_local_np(x) -> np.ndarray:
 
 
 def is_device_resident(x) -> bool:
-    """True for a committed, fully-addressable jax.Array — the inputs the
-    eager paths keep on device instead of round-tripping the host."""
+    """True for a fully-addressable jax.Array — the inputs the eager
+    paths keep on device instead of round-tripping the host."""
     return isinstance(x, jax.Array) and x.is_fully_addressable
 
 
