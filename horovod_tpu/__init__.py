@@ -200,5 +200,6 @@ def broadcast_parameters(params, root_rank: int = 0,
 from .opt import (  # noqa: E402,F401
     DistributedOptimizer,
     DistributedGradientTransformation,
+    cross_replica_sharded_optimizer,
     distributed_grad,
 )

@@ -213,3 +213,107 @@ def distributed_value_and_grad(
         return val, g
 
     return wrapped
+
+
+class _ShardedUpdate(NamedTuple):
+    inner: object
+
+
+def cross_replica_sharded_optimizer(inner: optax.GradientTransformation,
+                                    num_shards: int,
+                                    axis_name: str = DEFAULT_AXIS
+                                    ) -> optax.GradientTransformation:
+    """Shard the weight update across data-parallel replicas (ZeRO-1; the
+    XLA "automatic cross-replica sharding of weight update" optimization,
+    arXiv:2004.13336 — greenfield vs the reference, which always runs the
+    full update on every worker).
+
+    Inside a ``shard_map`` DP region, each chip:
+
+      1. reduce-scatters the gradients (``psum_scatter``) — same bytes on
+         the wire as allreduce, split as RS+AG around the update;
+      2. runs ``inner.update`` on its 1/num_shards slice of every leaf —
+         optimizer state (e.g. Adam's m/v) is **num_shards× smaller per
+         chip**, the classic ZeRO-1 memory win;
+      3. all-gathers the update slices back to full updates for
+         ``optax.apply_updates``.
+
+    Exact for elementwise optimizers (SGD/momentum/Adam/AdamW/...): the
+    sharded update equals the replicated update slice-for-slice. Not for
+    optimizers whose update couples elements across a leaf or reads the
+    tree structure (per-layer norms like LARS, Adafactor row factors,
+    ``optax.masked``/``multi_transform``) — use the plain wrapper for
+    those: the fused shard hands the inner optimizer ONE flat leaf per
+    dtype (the module's tensor-fusion contract — exactly one
+    reduce-scatter + all-gather pair per dtype per step).
+
+    Use under ``data_parallel_step`` / shard_map with ``axis_name`` in
+    scope; ``num_shards`` must equal the axis size (validated at trace
+    time).
+    """
+
+    def _chunk(total: int) -> int:
+        return -(-total // num_shards)
+
+    def _dtype_totals(tree) -> dict:
+        totals: dict = {}
+        for l in jax.tree.leaves(tree):
+            k = str(jnp.asarray(l).dtype)
+            totals[k] = totals.get(k, 0) + l.size
+        return dict(sorted(totals.items()))
+
+    def init(params):
+        shard_shaped = {dt: jnp.zeros((_chunk(total),), dtype=dt)
+                        for dt, total in _dtype_totals(params).items()}
+        return _ShardedUpdate(inner.init(shard_shaped))
+
+    def update(grads, state, params=None):
+        axis_n = jax.lax.axis_size(axis_name)
+        if axis_n != num_shards:
+            raise ValueError(
+                f"cross_replica_sharded_optimizer(num_shards={num_shards}) "
+                f"used under a {axis_n}-wide '{axis_name}' axis — gradient "
+                "scaling would be silently wrong")
+        idx = jax.lax.axis_index(axis_name)
+        leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = (jax.tree.leaves(params) if params is not None else None)
+        groups = {}  # dtype -> leaf indices, in flatten order
+        for i, l in enumerate(leaves):
+            groups.setdefault(str(l.dtype), []).append(i)
+        groups = dict(sorted(groups.items()))
+
+        def fuse(ls):
+            flat = (jnp.ravel(ls[0]) if len(ls) == 1
+                    else jnp.concatenate([jnp.ravel(x) for x in ls]))
+            c = _chunk(flat.size)
+            return jnp.pad(flat, (0, c * num_shards - flat.size)), c
+
+        g_shard, p_shard = {}, {}
+        meta = {}
+        for dt, idxs in groups.items():
+            fused_g, c = fuse([leaves[i] for i in idxs])
+            meta[dt] = c
+            g_shard[dt] = jax.lax.psum_scatter(
+                fused_g, axis_name, tiled=True) / num_shards
+            if p_leaves is not None:
+                fused_p, _ = fuse([p_leaves[i] for i in idxs])
+                p_shard[dt] = jax.lax.dynamic_slice(fused_p, (idx * c,), (c,))
+        u_shard, new_inner = inner.update(
+            g_shard, state.inner, p_shard if p_leaves is not None else None)
+
+        out = list(leaves)
+        for dt, idxs in groups.items():
+            full = jax.lax.all_gather(u_shard[dt], axis_name, tiled=True)
+            off = 0
+            for i in idxs:
+                # dtype ref: the param leaf when given — casting updates to
+                # a bf16 GRAD dtype under fp32 params would drift from the
+                # replicated trajectory
+                ref = p_leaves[i] if p_leaves is not None else leaves[i]
+                n_el = leaves[i].size
+                out[i] = jax.lax.slice(full, (off,), (off + n_el,)) \
+                    .reshape(leaves[i].shape).astype(ref.dtype)
+                off += n_el
+        return jax.tree.unflatten(treedef, out), _ShardedUpdate(new_inner)
+
+    return optax.GradientTransformation(init, update)
