@@ -277,26 +277,29 @@ def cross_replica_sharded_optimizer(inner: optax.GradientTransformation,
         idx = jax.lax.axis_index(axis_name)
         leaves, treedef = jax.tree.flatten(grads)
         p_leaves = (jax.tree.leaves(params) if params is not None else None)
+        # group by the PARAM dtype when params are given (init keyed state
+        # the same way): bf16 grads under fp32 params cast up before the
+        # sharded update — master-weight semantics, and the state dict
+        # keys always match init's
+        ref_leaves = p_leaves if p_leaves is not None else leaves
         groups = {}  # dtype -> leaf indices, in flatten order
-        for i, l in enumerate(leaves):
+        for i, l in enumerate(ref_leaves):
             groups.setdefault(str(l.dtype), []).append(i)
         groups = dict(sorted(groups.items()))
 
-        def fuse(ls):
-            flat = (jnp.ravel(ls[0]) if len(ls) == 1
-                    else jnp.concatenate([jnp.ravel(x) for x in ls]))
+        def fuse(ls, dt):
+            flats = [jnp.ravel(x).astype(dt) for x in ls]
+            flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
             c = _chunk(flat.size)
             return jnp.pad(flat, (0, c * num_shards - flat.size)), c
 
         g_shard, p_shard = {}, {}
-        meta = {}
         for dt, idxs in groups.items():
-            fused_g, c = fuse([leaves[i] for i in idxs])
-            meta[dt] = c
+            fused_g, c = fuse([leaves[i] for i in idxs], dt)
             g_shard[dt] = jax.lax.psum_scatter(
                 fused_g, axis_name, tiled=True) / num_shards
             if p_leaves is not None:
-                fused_p, _ = fuse([p_leaves[i] for i in idxs])
+                fused_p, _ = fuse([p_leaves[i] for i in idxs], dt)
                 p_shard[dt] = jax.lax.dynamic_slice(fused_p, (idx * c,), (c,))
         u_shard, new_inner = inner.update(
             g_shard, state.inner, p_shard if p_leaves is not None else None)

@@ -183,3 +183,26 @@ def test_cross_replica_sharded_optimizer_matches_replicated():
                                rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(z_p["b"]), np.asarray(ref_p["b"]),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_cross_replica_sharded_optimizer_mixed_precision():
+    """bf16 grads under fp32 params: grads cast up to the param dtype
+    before the sharded update (master-weight semantics) — must trace and
+    step without dtype-key mismatches."""
+    hvd.init()
+    mesh = hvd.global_process_set().mesh
+    n = hvd.size()
+    params = {"w": jnp.ones((9,), jnp.float32)}
+    opt = hvd.cross_replica_sharded_optimizer(optax.sgd(0.1), num_shards=n)
+    state = opt.init(params)
+
+    def step(p, s):
+        g = {"w": jnp.ones((9,), jnp.bfloat16)}  # local bf16 grads
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    f = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P(), P()),
+                              out_specs=(P(), P()), check_vma=False))
+    p2, _ = f(params, state)
+    assert p2["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(p2["w"]), 0.9, rtol=1e-6)
