@@ -217,3 +217,24 @@ def test_long_context_ring_attention_example():
                 os.path.join(EXAMPLES, "long_context_ring_attention.py"),
                 "--seq-len", "512", "--steps", "2", "--d-model", "128"])
     assert "tok/s" in out
+
+
+def test_scaling_harness_smoke():
+    """BASELINE's headline metric (scaling efficiency 1->N chips) has an
+    in-repo harness; smoke it on the virtual mesh."""
+    import json
+
+    import tempfile
+
+    out_json = os.path.join(tempfile.mkdtemp(), "scaling.json")
+    out = _run([sys.executable,
+                os.path.join(REPO, "benchmarks", "bench_scaling.py"),
+                "--per-chip", "64", "--iters", "2", "--warmup", "1",
+                "--output", out_json],
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    line = next(ln for ln in out.splitlines()
+                if ln.startswith("BENCH-SCALING"))
+    data = json.loads(line.split("BENCH-SCALING ")[1])
+    assert [r["chips"] for r in data["rows"]] == [1, 2, 4, 8]
+    assert data["rows"][0]["efficiency"] == 1.0
