@@ -223,10 +223,12 @@ def cross_replica_sharded_optimizer(inner: optax.GradientTransformation,
                                     num_shards: int,
                                     axis_name: str = DEFAULT_AXIS
                                     ) -> optax.GradientTransformation:
-    """Shard the weight update across data-parallel replicas (ZeRO-1; the
-    XLA "automatic cross-replica sharding of weight update" optimization,
-    arXiv:2004.13336 — greenfield vs the reference, which always runs the
-    full update on every worker).
+    """Shard the weight update across data-parallel replicas (ZeRO-1).
+
+    The XLA "automatic cross-replica sharding of weight update"
+    optimization (arXiv:2004.13336) as an explicit optax wrapper —
+    greenfield vs the reference, which always runs the full update on
+    every worker.
 
     Inside a ``shard_map`` DP region, each chip:
 

@@ -132,3 +132,24 @@ def test_resnet50_space_to_depth_stem():
     shapes1 = jax.tree.map(lambda a: a.shape, rest1)
     shapes2 = jax.tree.map(lambda a: a.shape, rest2)
     assert shapes1 == shapes2
+
+
+def test_transformer_remat_matches_no_remat():
+    """cfg.remat=True (per-block jax.checkpoint) changes memory, not
+    math: loss and grads match the non-remat forward/backward."""
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, max_seq=16,
+                              dtype=jnp.float32, dp_axis=None,
+                              tp_axis=None, sp_axis=None)
+    import dataclasses
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+
+    loss = lambda p, c: T.lm_loss(p, toks, c, use_constraints=False)
+    l1, g1 = jax.value_and_grad(loss)(params, cfg)
+    l2, g2 = jax.value_and_grad(loss)(params, cfg_r)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
