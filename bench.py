@@ -240,6 +240,11 @@ def main():
                 tuned = json.load(f)
             tuned_batch = int(tuned.get("batch", tuned_batch))
             tuned_scan = int(tuned.get("scan_steps", tuned_scan))
+            if tuned.get("s2d") and not quick:
+                # campaign found the space-to-depth stem faster here
+                # (quick/CI smoke keeps the standard stem, like it keeps
+                # its own batch/scan)
+                os.environ.setdefault("HVD_BENCH_S2D", "1")
         except Exception:
             pass
     per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else tuned_batch)
