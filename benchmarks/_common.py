@@ -1,0 +1,44 @@
+"""Shared helpers for the benchmark/measurement scripts."""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def make_recorder(path):
+    """JSONL appender: one flushed line per event, ts-stamped, echoed to
+    stdout so partial progress survives interruptions."""
+    def record(**kw):
+        kw["ts"] = time.time()
+        with open(path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+        print(json.dumps(kw), flush=True)
+    return record
+
+
+def enable_compilation_cache():
+    """Same cache dir as bench.py (<repo>/.jax_cache) so the campaign's
+    compiles pre-warm the driver's end-of-round bench run."""
+    from horovod_tpu.utils.compile_cache import enable_compilation_cache as en
+
+    en(os.path.join(REPO, ".jax_cache"))
+
+
+def require_tpu():
+    """Refuse to let a measurement phase run (and mark itself done) on a
+    CPU fallback backend. Override with HVD_ALLOW_CPU_PHASE=1 for local
+    testing of the scripts themselves."""
+    import jax
+
+    if os.environ.get("HVD_ALLOW_CPU_PHASE") == "1":
+        return
+    d = jax.devices()[0]
+    ident = (d.platform + " " + d.device_kind).lower()
+    if "tpu" not in ident:
+        raise SystemExit(f"phase requires a TPU device, got {ident!r} "
+                         "(set HVD_ALLOW_CPU_PHASE=1 to override)")

@@ -1,0 +1,116 @@
+"""Transformer-LM MFU on one chip.
+
+The flagship ResNet's MFU is capped by the platform's conv lowering (see
+probe_conv.py / docs/benchmarks.md); transformer training is
+matmul-dominated, so it shows what fraction of the chip's measured
+matmul peak the full framework path (model + loss + grads + fused
+DistributedOptimizer update) actually sustains.
+
+MFU accounting: analytic matmul FLOPs of the non-remat forward (remat
+recompute is not useful work), training = 3x forward. Appends JSON
+lines to benchmarks/transformer_mfu.jsonl.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from _common import enable_compilation_cache, make_recorder, require_tpu
+
+record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "transformer_mfu.jsonl"))
+
+
+def fwd_flops_per_token(cfg, seq):
+    """Matmul FLOPs per token of one forward pass (analytic)."""
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    per_block = 8 * d * d + 4 * d * f + 4 * seq * d  # qkv+wo, ffn, attn
+    return cfg.n_layers * per_block + 2 * d * v  # + logits matmul
+
+
+def bench_lm(d_model=2048, n_layers=12, d_ff=8192, n_heads=16,
+             vocab=32768, seq=1024, batch=8, scan_steps=8,
+             warmup=2, iters=4, remat=True):
+    import horovod_tpu as hvd
+    from horovod_tpu.models import transformer as T
+    from bench import chip_peak_flops
+
+    cfg = T.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq=seq, dtype=jnp.bfloat16,
+        remat=remat)
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    opt = hvd.DistributedOptimizer(optax.sgd(1e-3, momentum=0.9))
+    opt_state = opt.init(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, vocab, (batch, seq)))
+
+    def one_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(T.lm_loss)(
+            params, tokens, cfg, use_constraints=False)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def step(params, opt_state, tokens):
+        if scan_steps <= 1:
+            return one_step(params, opt_state, tokens)
+
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = one_step(p, s, tokens)
+            return (p, s), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=scan_steps)
+        return params, opt_state, losses[-1]
+
+    compiled = jax.jit(step, donate_argnums=(0, 1))
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        params, opt_state, loss = compiled(params, opt_state, tokens)
+    float(jnp.asarray(loss))
+    compile_s = time.perf_counter() - t_c0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = compiled(params, opt_state, tokens)
+    float(jnp.asarray(loss))
+    dt = (time.perf_counter() - t0) / iters
+    tokens_per_step = batch * (seq - 1) * max(scan_steps, 1)
+    tok_s = tokens_per_step / dt
+    flops = tok_s * fwd_flops_per_token(cfg, seq - 1) * 3.0
+    peak = chip_peak_flops()
+    record(event="lm", d_model=d_model, n_layers=n_layers, d_ff=d_ff,
+           seq=seq, batch=batch, scan=scan_steps, remat=remat,
+           tok_s=round(tok_s, 1), tflops=round(flops / 1e12, 2),
+           mfu=round(flops / peak, 4), compile_s=round(compile_s, 1))
+    return flops / peak
+
+
+def main():
+    import horovod_tpu as hvd
+
+    enable_compilation_cache()
+    require_tpu()
+    hvd.init()
+    record(event="start", device=jax.devices()[0].device_kind)
+    for kw in (
+            dict(scan_steps=8),
+            dict(scan_steps=1),
+            dict(seq=2048, batch=4, scan_steps=8),
+    ):
+        try:
+            bench_lm(**kw)
+        except Exception as e:
+            record(event="lm_error", config=kw,
+                   error=f"{type(e).__name__}: {e}"[:200])
+
+
+if __name__ == "__main__":
+    main()

@@ -14,25 +14,21 @@ isolates the cause:
 Appends JSON lines to benchmarks/probe_conv.jsonl.
 """
 
-import json
 import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "probe_conv.jsonl")
+from _common import enable_compilation_cache, make_recorder, require_tpu
 
-
-def record(**kw):
-    kw["ts"] = time.time()
-    with open(RESULTS, "a") as f:
-        f.write(json.dumps(kw) + "\n")
-    print(json.dumps(kw), flush=True)
+record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "probe_conv.jsonl"))
 
 
 def timeit(f, *args, warmup=3, iters=20):
@@ -48,6 +44,8 @@ def timeit(f, *args, warmup=3, iters=20):
 
 
 def main():
+    enable_compilation_cache()
+    require_tpu()
     record(event="start", device=jax.devices()[0].device_kind)
 
     # 0. dispatch latency: how much does one tunnel round trip cost?
@@ -64,7 +62,37 @@ def main():
     record(event="dispatch_scan100", ms_total=round(dt_scan * 1e3, 3),
            ms_per_step=round(dt_scan * 10, 4))
 
-    # 1. matmul reference point at conv-comparable FLOPs (~59 GFLOP)
+    # 1. THE DECISIVE COMPARISON FIRST (the tunnel's uptime windows can
+    # be minutes long): native 3x3 conv vs the same conv as im2col +
+    # matmul vs a bare matmul of the same FLOPs.
+    x = jnp.asarray(np.random.randn(256, 28, 28, 128), jnp.bfloat16)
+    k3 = jnp.asarray(np.random.randn(3, 3, 128, 128), jnp.bfloat16)
+    flops3 = 2 * 256 * 28 * 28 * 3 * 3 * 128 * 128
+
+    def im2col_conv(x, k):
+        n_, h, w, c = x.shape
+        kh, kw, _, co = k.shape
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return (patches.reshape(-1, c * kh * kw)
+                @ k.transpose(2, 0, 1, 3).reshape(c * kh * kw, co)
+                ).reshape(n_, h, w, co)
+
+    g = jax.jit(im2col_conv)
+    dt = timeit(g, x, k3, warmup=2, iters=10)
+    record(event="im2col_3x3_c128_bf16", ms=round(dt * 1e3, 3),
+           tflops=round(flops3 / dt / 1e12, 2))
+
+    # numerics check vs native conv (f32 reference)
+    ref = lax.conv_general_dilated(
+        x.astype(jnp.float32), k3.astype(jnp.float32), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = g(x, k3).astype(jnp.float32)
+    err = float(jnp.max(jnp.abs(ref - got)) / (jnp.max(jnp.abs(ref)) + 1e-9))
+    record(event="im2col_relerr", relerr=round(err, 5))
+
+    # matmul reference point at conv-comparable FLOPs (~59 GFLOP)
     m, k, n = 3136, 4096, 2304
     a = jnp.asarray(np.random.randn(m, k), jnp.bfloat16)
     b = jnp.asarray(np.random.randn(k, n), jnp.bfloat16)
@@ -105,45 +133,18 @@ def main():
     conv_bench("1x1_c512_bf16", (64, 28, 28, 512), (1, 1, 512, 1024), (1, 1),
                jnp.bfloat16)
 
-    # 3. the same 3x3 conv as im2col + matmul
-    def im2col_conv(x, k):
-        n_, h, w, c = x.shape
-        kh, kw, _, co = k.shape
-        patches = lax.conv_general_dilated_patches(
-            x, (kh, kw), (1, 1), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return (patches.reshape(-1, c * kh * kw)
-                @ k.transpose(2, 0, 1, 3).reshape(c * kh * kw, co)
-                ).reshape(n_, h, w, co)
-
-    x = jnp.asarray(np.random.randn(256, 28, 28, 128), jnp.bfloat16)
-    k = jnp.asarray(np.random.randn(3, 3, 128, 128), jnp.bfloat16)
-    g = jax.jit(im2col_conv)
-    dt = timeit(g, x, k, warmup=2, iters=10)
-    flops = 2 * 256 * 28 * 28 * 3 * 3 * 128 * 128
-    record(event="im2col_3x3_c128_bf16", ms=round(dt * 1e3, 3),
-           tflops=round(flops / dt / 1e12, 2))
-
-    # numerics check vs native conv
-    ref = lax.conv_general_dilated(
-        x.astype(jnp.float32), k.astype(jnp.float32), (1, 1), "SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    got = g(x, k).astype(jnp.float32)
-    err = float(jnp.max(jnp.abs(ref - got)) / (jnp.max(jnp.abs(ref)) + 1e-9))
-    record(event="im2col_relerr", relerr=round(err, 5))
-
-    # 4. scan-amortized conv: is it dispatch latency after all?
-    def conv_scan(x, k):
+    # 3. scan-amortized conv: is it dispatch latency after all?
+    def conv_scan(x, kern):
         def body(c, _):
             return lax.conv_general_dilated(
-                c, k, (1, 1), "SAME",
+                c, kern, (1, 1), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC")), ()
         return lax.scan(body, x, None, length=8)[0]
 
     g = jax.jit(conv_scan)
-    dt = timeit(g, x, k, warmup=2, iters=5)
+    dt = timeit(g, x, k3, warmup=2, iters=5)
     record(event="conv_scan8_3x3_c128", ms_per_conv=round(dt * 1e3 / 8, 3),
-           tflops=round(8 * flops / dt / 1e12, 2))
+           tflops=round(8 * flops3 / dt / 1e12, 2))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,78 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def _same_pads(size: int, k: int, s: int) -> tuple:
+    """TF-'SAME' padding for one spatial dim."""
+    out = -(-size // s)
+    total = max((out - 1) * s + k - size, 0)
+    return total // 2, total - total // 2
+
+
+class Im2ColConv(nn.Module):
+    """2-D convolution as shifted-slice stacking + ONE matmul.
+
+    Conv-free lowering for platforms whose native ``conv_general_dilated``
+    path underperforms (the tunneled 'axon' TPU runs native convs at
+    0.4-1% MFU vs 31% for matmuls — benchmarks/probe_conv.py). Patch
+    extraction is pure data movement: for each kernel tap (di, dj), a
+    strided slice of the padded input; taps concatenate on the channel
+    axis in (kh, kw, cin) order so the flattened kernel matches
+    ``nn.Conv``'s ``(kh, kw, cin, cout)`` parameter exactly — state dicts
+    interchange between the two implementations.
+    """
+
+    features: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        sh, sw = self.strides if isinstance(self.strides, tuple) \
+            else (self.strides, self.strides)
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features), jnp.float32)
+        x = x.astype(self.dtype)
+        kernel = kernel.astype(self.dtype)
+
+        n, h, w, _ = x.shape
+        if self.padding == "SAME":
+            ph, pw = _same_pads(h, kh, sh), _same_pads(w, kw, sw)
+        elif self.padding == "VALID":
+            ph = pw = (0, 0)
+        else:
+            ph, pw = self.padding
+        x = jnp.pad(x, ((0, 0), ph, pw, (0, 0)))
+        hp, wp = x.shape[1], x.shape[2]
+        ho = (hp - kh) // sh + 1
+        wo = (wp - kw) // sw + 1
+
+        taps = []
+        for di in range(kh):
+            for dj in range(kw):
+                taps.append(x[:, di:di + (ho - 1) * sh + 1:sh,
+                              dj:dj + (wo - 1) * sw + 1:sw, :])
+        patches = jnp.concatenate(taps, axis=-1)  # (n, ho, wo, kh*kw*cin)
+        out = patches.reshape(n * ho * wo, kh * kw * cin) \
+            @ kernel.reshape(kh * kw * cin, self.features)
+        out = out.reshape(n, ho, wo, self.features)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            out = out + bias.astype(self.dtype)
+        return out
+
+
+# flax auto-names submodule scopes by class __name__; sharing nn.Conv's
+# makes native and im2col param trees byte-interchangeable
+Im2ColConv.__name__ = "Conv"
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -60,10 +132,19 @@ class ResNet(nn.Module):
     # conv on 12 channels at half resolution — same downstream dims,
     # ~equal FLOPs, far better systolic-array utilization
     space_to_depth: bool = False
+    # "native" = nn.Conv (XLA conv_general_dilated); "im2col" = Im2ColConv
+    # (shifted-slice + matmul — for platforms with a degenerate native
+    # conv path; parameters interchange between the two)
+    conv_impl: str = "native"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        impls = {"native": nn.Conv, "im2col": Im2ColConv}
+        if self.conv_impl not in impls:
+            raise ValueError(
+                f"conv_impl={self.conv_impl!r}; valid: {sorted(impls)}")
+        conv = partial(impls[self.conv_impl], use_bias=False,
+                       dtype=self.dtype)
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        axis_name=self.axis_name)

@@ -1,0 +1,35 @@
+"""Persistent XLA compilation cache helper.
+
+On tunneled/remote TPU platforms, compiles are RPCs to a service whose
+availability can flap; a persistent cache makes every successfully
+compiled program a one-time cost for the machine rather than per
+process. (The reference has no analogue — CUDA kernels ship prebuilt;
+for XLA the compile IS the build step, so cache management belongs in
+the framework.)
+"""
+
+import os
+from typing import Optional
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None,
+                             min_compile_time_secs: float = 1.0) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (default: ``$HOROVOD_COMPILE_CACHE`` or ``~/.cache/horovod_tpu_xla``).
+    Returns True if enabled. Never raises: the cache is an optimization.
+    """
+    import jax
+
+    try:
+        cache_dir = (cache_dir
+                     or os.environ.get("HOROVOD_COMPILE_CACHE")
+                     or os.path.join(os.path.expanduser("~"), ".cache",
+                                     "horovod_tpu_xla"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception:
+        return False

@@ -153,3 +153,61 @@ def test_transformer_remat_matches_no_remat():
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-7)
+
+
+def test_im2col_conv_matches_native():
+    """Im2ColConv == nn.Conv for the same 'kernel' parameter, across the
+    kernel/stride/padding shapes ResNet actually uses (conv-free lowering
+    for the degenerate-native-conv platform; benchmarks/probe_conv.py)."""
+    import flax.linen as nn
+    from horovod_tpu.models.resnet import Im2ColConv
+
+    rng = np.random.RandomState(0)
+    cases = [
+        ((2, 16, 16, 3), 8, (7, 7), (2, 2), [(3, 3), (3, 3)]),
+        ((2, 9, 9, 4), 8, (3, 3), (1, 1), "SAME"),
+        ((2, 9, 9, 4), 8, (3, 3), (2, 2), "SAME"),
+        ((2, 8, 8, 4), 6, (1, 1), (1, 1), "SAME"),
+        ((2, 8, 8, 4), 6, (1, 1), (2, 2), "SAME"),
+        ((2, 10, 10, 2), 5, (4, 4), (1, 1), "SAME"),
+        ((2, 10, 10, 2), 5, (3, 3), (1, 1), "VALID"),
+    ]
+    for xs, feats, ks, st, pad in cases:
+        x = jnp.asarray(rng.randn(*xs), jnp.float32)
+        native = nn.Conv(feats, ks, strides=st, padding=pad, use_bias=False,
+                         dtype=jnp.float32)
+        im2col = Im2ColConv(feats, ks, strides=st, padding=pad,
+                            use_bias=False, dtype=jnp.float32)
+        v = native.init(jax.random.PRNGKey(1), x)
+        out_n = native.apply(v, x)
+        out_i = im2col.apply(v, x)  # same param pytree: interchangeable
+        assert out_n.shape == out_i.shape, (ks, st, pad)
+        np.testing.assert_allclose(np.asarray(out_n), np.asarray(out_i),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_im2col_full_model_matches_native():
+    """Whole-model equivalence: ResNet-50 forward + grads agree between
+    conv_impl='native' and 'im2col' on the SAME variables."""
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    native = ResNet50(num_classes=10, dtype=jnp.float32)
+    im2col = ResNet50(num_classes=10, dtype=jnp.float32,
+                      conv_impl="im2col")
+    v = native.init(jax.random.PRNGKey(0), x, train=False)
+    out_n = native.apply(v, x, train=False)
+    out_i = im2col.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_n), np.asarray(out_i),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(params, model):
+        logits = model.apply({"params": params,
+                              "batch_stats": v["batch_stats"]},
+                             x, train=False)
+        return jnp.mean(logits ** 2)
+
+    g_n = jax.grad(loss)(v["params"], native)
+    g_i = jax.grad(loss)(v["params"], im2col)
+    for a, b in zip(jax.tree.leaves(g_n), jax.tree.leaves(g_i)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
