@@ -29,6 +29,25 @@ def enable_compilation_cache():
     en(os.path.join(REPO, ".jax_cache"))
 
 
+def write_tuned_if_better(cfg: dict) -> bool:
+    """Write benchmarks/bench_tuned.json only if ``cfg['img_s']`` beats
+    the existing file's — concurrent/sequential campaigns must never
+    clobber a faster config. Returns True when written."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_tuned.json")
+    prev = -1.0
+    try:
+        with open(path) as f:
+            prev = float(json.load(f).get("img_s", -1.0))
+    except Exception:
+        pass
+    if float(cfg.get("img_s", 0.0)) > prev:
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        return True
+    return False
+
+
 def require_tpu():
     """Refuse to let a measurement phase run (and mark itself done) on a
     CPU fallback backend. Override with HVD_ALLOW_CPU_PHASE=1 for local

@@ -100,6 +100,7 @@ def main():
     require_tpu()
     hvd.init()
     record(event="start", device=jax.devices()[0].device_kind)
+    ok = 0
     for kw in (
             dict(scan_steps=8),
             dict(scan_steps=1),
@@ -107,9 +108,12 @@ def main():
     ):
         try:
             bench_lm(**kw)
+            ok += 1
         except Exception as e:
             record(event="lm_error", config=kw,
                    error=f"{type(e).__name__}: {e}"[:200])
+    if not ok:
+        sys.exit(3)  # zero measurements: do not mark the phase done
 
 
 if __name__ == "__main__":

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from _common import enable_compilation_cache, make_recorder, require_tpu
+from _common import (enable_compilation_cache, make_recorder,
+                     require_tpu, write_tuned_if_better)
 
 record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "mfu_results.jsonl"))
@@ -113,6 +114,8 @@ def main():
                 if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
                     break  # OOM: larger scan won't help at this batch
 
+    if best is None:
+        sys.exit(3)  # no sweep data: the phase must NOT be marked done
     if best is not None:
         cfg = {"batch": best[1], "scan_steps": best[2],
                "img_s": round(best[0], 1)}
@@ -141,19 +144,8 @@ def main():
         # bench.py picks this up (env vars win). NEVER clobber a faster
         # config someone else (resnet_phase.py's im2col trials) already
         # wrote — this sweep only covers native convs.
-        tuned = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_tuned.json")
-        prev_img_s = -1.0
-        try:
-            with open(tuned) as f:
-                prev_img_s = float(json.load(f).get("img_s", -1.0))
-        except Exception:
-            pass
-        if cfg["img_s"] > prev_img_s:
-            with open(tuned, "w") as f:
-                json.dump(cfg, f)
-        else:
-            record(event="tuned_kept_existing", existing_img_s=prev_img_s)
+        if not write_tuned_if_better(cfg):
+            record(event="tuned_kept_existing")
 
         # 3. fwd-only at the winning batch: locates the residual deficit
         # (forward conv stack vs backward) for docs/benchmarks.md

@@ -22,15 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from _common import enable_compilation_cache, make_recorder, require_tpu
+from _common import (enable_compilation_cache, make_recorder,
+                     require_tpu, write_tuned_if_better)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 record = make_recorder(os.path.join(_HERE, "mfu_results.jsonl"))
 
 
-def write_tuned(cfg):
-    with open(os.path.join(_HERE, "bench_tuned.json"), "w") as f:
-        json.dump(cfg, f)
 
 
 def main():
@@ -71,7 +69,7 @@ def main():
         sys.exit(3)
     cfg = {"batch": best[1], "scan_steps": best[2], "conv_impl": best[3],
            "img_s": round(best[0], 1)}
-    write_tuned(cfg)
+    write_tuned_if_better(cfg)
 
     try:
         ips = bench_resnet(best[1], warmup=2, iters=4, scan_steps=best[2],
@@ -81,7 +79,7 @@ def main():
                mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
         if ips > best[0]:
             cfg.update(s2d=True, img_s=round(ips, 1))
-            write_tuned(cfg)
+            write_tuned_if_better(cfg)
     except Exception as e:
         record(event="resnet_s2d_error", error=f"{type(e).__name__}: {e}"[:200])
 

@@ -5,3 +5,4 @@ from .tp import (column_parallel_dense, row_parallel_dense, parallel_mlp,  # noq
 from .sp import ring_attention, ulysses_attention  # noqa: F401
 from .pp import pipeline_apply, pipeline_loss  # noqa: F401
 from .moe import moe_layer, top1_gating  # noqa: F401
+from .fsdp import fsdp_specs, opt_state_specs, fsdp_train_step  # noqa: F401

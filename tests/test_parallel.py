@@ -305,3 +305,95 @@ def test_moe_layer_top2_runs_on_mesh():
     assert y.shape == x.shape
     assert np.all(np.isfinite(np.asarray(y)))
     assert np.asarray(y).any()
+
+
+def test_fsdp_specs_shard_large_replicate_small():
+    from horovod_tpu.parallel import fsdp_specs
+
+    params = {"w": jnp.zeros((256, 128)), "scale": jnp.zeros((128,)),
+              "odd": jnp.zeros((130, 3))}
+    specs = fsdp_specs(params, axis="dp", min_shard_elems=1024, axis_size=8)
+    assert specs["w"] == P("dp", None)          # largest dim 256 % 8 == 0
+    assert specs["scale"] == P()                # small -> replicated
+    assert specs["odd"] == P()                  # no dim divisible by 8
+    # without axis_size constraint the largest dim is taken as-is
+    specs2 = fsdp_specs(params, axis="dp", min_shard_elems=64)
+    assert specs2["scale"] == P("dp")
+    assert specs2["odd"] == P("dp", None)
+
+
+def test_fsdp_matches_replicated_dp():
+    """ZeRO-3 sharding is a memory layout, not a math change: the FSDP
+    train step's trajectory equals single-device training on the global
+    batch, and params/opt-state actually live sharded."""
+    import optax
+    from horovod_tpu.parallel import create_mesh, fsdp_train_step
+
+    n = len(jax.devices())
+    mesh = create_mesh({"dp": n})
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(32, 64), jnp.float32),
+              "b1": jnp.asarray(rng.randn(64), jnp.float32),
+              "w2": jnp.asarray(rng.randn(64, 8), jnp.float32)}
+    x = jnp.asarray(rng.randn(n * 4, 32), jnp.float32)
+    y = jnp.asarray(rng.randn(n * 4, 8), jnp.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - yb) ** 2)
+
+    opt = optax.adam(1e-2)
+
+    # reference: plain single-program training on the full batch
+    ref_p, ref_s = params, opt.init(params)
+    for _ in range(3):
+        g = jax.grad(loss_fn)(ref_p, (x, y))
+        u, ref_s = opt.update(g, ref_s, ref_p)
+        ref_p = optax.apply_updates(ref_p, u)
+
+    make = fsdp_train_step(loss_fn, opt, mesh, axis="dp",
+                           min_shard_elems=64,
+                           batch_spec=(P("dp", None), P("dp", None)))
+    fp, fs, step = make(params, opt.init(params))
+    # the big leaves are genuinely sharded across devices
+    assert fp["w1"].sharding.spec == P(None, "dp")  # largest dim = 64
+    m_state = fs[0].mu["w1"]
+    assert m_state.sharding.spec == P(None, "dp")
+    for _ in range(3):
+        fp, fs, loss = step(fp, fs, (x, y))
+    for k in params:
+        np.testing.assert_allclose(np.asarray(fp[k]), np.asarray(ref_p[k]),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fsdp_transformer_step_runs_sharded():
+    """FSDP composes with the transformer LM: one jitted step over an
+    8-way mesh with every big leaf 1/8 per chip."""
+    import optax
+    from horovod_tpu.models import transformer as T
+    from horovod_tpu.parallel import create_mesh, fsdp_train_step
+
+    n = len(jax.devices())
+    mesh = create_mesh({"dp": n})
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, max_seq=16,
+                              dtype=jnp.float32, dp_axis=None, tp_axis=None,
+                              sp_axis=None)
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (n * 2, 16)))
+
+    def loss_fn(p, batch):
+        return T.lm_loss(p, batch, cfg, use_constraints=False)
+
+    opt = optax.adam(1e-3)
+    make = fsdp_train_step(loss_fn, opt, mesh, axis="dp",
+                           min_shard_elems=256, batch_spec=P("dp", None))
+    fp, fs, step = make(params, opt.init(params))
+    assert fp["embed"].sharding.spec == P("dp", None)
+    losses = []
+    for _ in range(3):
+        fp, fs, loss = step(fp, fs, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
