@@ -10,15 +10,48 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+_LAST_PROGRESS = [time.time()]
+
+
 def make_recorder(path):
     """JSONL appender: one flushed line per event, ts-stamped, echoed to
-    stdout so partial progress survives interruptions."""
+    stdout so partial progress survives interruptions. Each record also
+    feeds the stall watchdog's progress clock."""
     def record(**kw):
         kw["ts"] = time.time()
         with open(path, "a") as f:
             f.write(json.dumps(kw) + "\n")
         print(json.dumps(kw), flush=True)
+        _LAST_PROGRESS[0] = time.time()
     return record
+
+
+def start_stall_watchdog(timeout_s: float = 600.0):
+    """Hard-exit the phase if no record() lands for ``timeout_s``.
+
+    The tunnel's observed failure mode is a silent mid-run wedge: an RPC
+    that never returns (r3: the MFU campaign finished its compile, then
+    hung 25+ min fetching the first result). A hung phase would otherwise
+    burn its whole orchestrator timeout before the watcher can even
+    re-probe — this converts that into a bounded ``timeout_s`` loss.
+    ``timeout_s`` must cover one remote compile (~3 min observed for the
+    ResNet train step, longer for big transformers) plus one measured
+    config. Exit code 42 marks a watchdog abort in watch.log.
+    """
+    import threading
+
+    _LAST_PROGRESS[0] = time.time()
+
+    def watch():
+        while True:
+            idle = time.time() - _LAST_PROGRESS[0]
+            if idle > timeout_s:
+                print(f"STALL-WATCHDOG: no progress for {idle:.0f}s, "
+                      "aborting phase", flush=True)
+                os._exit(42)
+            time.sleep(min(10.0, timeout_s / 3.0))
+
+    threading.Thread(target=watch, daemon=True).start()
 
 
 def enable_compilation_cache():
@@ -29,10 +62,12 @@ def enable_compilation_cache():
     en(os.path.join(REPO, ".jax_cache"))
 
 
-def write_tuned_if_better(cfg: dict) -> bool:
+def write_tuned_if_better(cfg: dict):
     """Write benchmarks/bench_tuned.json only if ``cfg['img_s']`` beats
     the existing file's — concurrent/sequential campaigns must never
-    clobber a faster config. Returns True when written."""
+    clobber a faster config. tmp + os.replace so a SIGTERM/watchdog kill
+    mid-write can't truncate the file a later read depends on. Returns
+    ``(written, prev_img_s)`` so callers can log the margin."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_tuned.json")
     prev = -1.0
@@ -42,10 +77,12 @@ def write_tuned_if_better(cfg: dict) -> bool:
     except Exception:
         pass
     if float(cfg.get("img_s", 0.0)) > prev:
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(cfg, f)
-        return True
-    return False
+        os.replace(tmp, path)
+        return True, prev
+    return False, prev
 
 
 def require_tpu():

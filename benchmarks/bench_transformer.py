@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from _common import enable_compilation_cache, make_recorder, require_tpu
+from _common import (enable_compilation_cache, make_recorder, require_tpu,
+                     start_stall_watchdog)
 
 record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "transformer_mfu.jsonl"))
@@ -98,6 +99,7 @@ def main():
 
     enable_compilation_cache()
     require_tpu()
+    start_stall_watchdog(1200)
     hvd.init()
     record(event="start", device=jax.devices()[0].device_kind)
     ok = 0
@@ -107,6 +109,9 @@ def main():
             dict(seq=2048, batch=4, scan_steps=8),
     ):
         try:
+            # heartbeat: the watchdog budget covers THIS config's
+            # compile+measure, not the accumulated run
+            record(event="config_start", config=kw)
             bench_lm(**kw)
             ok += 1
         except Exception as e:

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from _common import (enable_compilation_cache, make_recorder,
-                     require_tpu, write_tuned_if_better)
+                     require_tpu, start_stall_watchdog,
+                     write_tuned_if_better)
 
 record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "mfu_results.jsonl"))
@@ -31,6 +32,7 @@ def main():
 
     enable_compilation_cache()
     require_tpu()
+    start_stall_watchdog(900)
     hvd.init()
     PEAK = chip_peak_flops()
     record(event="start", device=jax.devices()[0].device_kind)
@@ -116,61 +118,61 @@ def main():
 
     if best is None:
         sys.exit(3)  # no sweep data: the phase must NOT be marked done
-    if best is not None:
-        cfg = {"batch": best[1], "scan_steps": best[2],
-               "img_s": round(best[0], 1)}
-        record(event="tuned", **cfg)
+    cfg = {"batch": best[1], "scan_steps": best[2],
+           "img_s": round(best[0], 1)}
+    record(event="tuned", **cfg)
 
-        # 2b. space-to-depth stem at the winning config (MLPerf TPU stem:
-        # the 7x7/s2 conv on 3 channels lights 3 of 128 MXU lanes; s2d
-        # lights 12). If it wins, it becomes the tuned default.
-        try:
-            ips = bench_resnet(
-                best[1], warmup=2, iters=4, scan_steps=best[2],
-                model_fn=lambda: ResNet50(num_classes=1000,
-                                          dtype=jnp.bfloat16,
-                                          space_to_depth=True))
-            record(event="resnet_s2d", batch=best[1], scan=best[2],
-                   img_s=round(ips, 1),
-                   mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
-            if ips > best[0]:
-                cfg.update(s2d=True, img_s=round(ips, 1))
-                record(event="tuned_s2d", img_s=round(ips, 1))
-        except Exception as e:
-            record(event="resnet_s2d_error",
-                   error=f"{type(e).__name__}: {e}"[:200])
+    # 2b. space-to-depth stem at the winning config (MLPerf TPU stem:
+    # the 7x7/s2 conv on 3 channels lights 3 of 128 MXU lanes; s2d
+    # lights 12). If it wins, it becomes the tuned default.
+    try:
+        ips = bench_resnet(
+            best[1], warmup=2, iters=4, scan_steps=best[2],
+            model_fn=lambda: ResNet50(num_classes=1000,
+                                      dtype=jnp.bfloat16,
+                                      space_to_depth=True))
+        record(event="resnet_s2d", batch=best[1], scan=best[2],
+               img_s=round(ips, 1),
+               mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
+        if ips > best[0]:
+            cfg.update(s2d=True, img_s=round(ips, 1))
+            record(event="tuned_s2d", img_s=round(ips, 1))
+    except Exception as e:
+        record(event="resnet_s2d_error",
+               error=f"{type(e).__name__}: {e}"[:200])
 
-        # one write, after the s2d trial decided the final config;
-        # bench.py picks this up (env vars win). NEVER clobber a faster
-        # config someone else (resnet_phase.py's im2col trials) already
-        # wrote — this sweep only covers native convs.
-        if not write_tuned_if_better(cfg):
-            record(event="tuned_kept_existing")
+    # one write, after the s2d trial decided the final config;
+    # bench.py picks this up (env vars win). NEVER clobber a faster
+    # config someone else (resnet_phase.py's im2col trials) already
+    # wrote — this sweep only covers native convs.
+    written, prev = write_tuned_if_better(cfg)
+    if not written:
+        record(event="tuned_kept_existing", existing_img_s=prev)
 
-        # 3. fwd-only at the winning batch: locates the residual deficit
-        # (forward conv stack vs backward) for docs/benchmarks.md
-        try:
-            from horovod_tpu.models import ResNet50
+    # 3. fwd-only at the winning batch: locates the residual deficit
+    # (forward conv stack vs backward) for docs/benchmarks.md
+    try:
+        from horovod_tpu.models import ResNet50
 
-            model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-            x = jnp.asarray(np.random.randn(best[1], 224, 224, 3),
-                            jnp.bfloat16)
-            variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
-            fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
-            for _ in range(3):
-                out = fwd(variables, x)
-            float(jnp.asarray(out).ravel()[0])
-            t0 = time.perf_counter()
-            for _ in range(10):
-                out = fwd(variables, x)
-            float(jnp.asarray(out).ravel()[0])
-            dt = (time.perf_counter() - t0) / 10
-            ips = best[1] / dt
-            record(event="fwd_only", batch=best[1], img_s=round(ips, 1),
-                   mfu=round(ips * FWD / PEAK, 4))
-        except Exception as e:
-            record(event="fwd_only_error",
-                   error=f"{type(e).__name__}: {e}"[:200])
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        x = jnp.asarray(np.random.randn(best[1], 224, 224, 3),
+                        jnp.bfloat16)
+        variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
+        fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+        for _ in range(3):
+            out = fwd(variables, x)
+        float(jnp.asarray(out).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = fwd(variables, x)
+        float(jnp.asarray(out).ravel()[0])
+        dt = (time.perf_counter() - t0) / 10
+        ips = best[1] / dt
+        record(event="fwd_only", batch=best[1], img_s=round(ips, 1),
+               mfu=round(ips * FWD / PEAK, 4))
+    except Exception as e:
+        record(event="fwd_only_error",
+               error=f"{type(e).__name__}: {e}"[:200])
 
 
 if __name__ == "__main__":

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from _common import enable_compilation_cache, make_recorder, require_tpu
+from _common import (enable_compilation_cache, make_recorder, require_tpu,
+                     start_stall_watchdog)
 
 record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "probe_conv.jsonl"))
@@ -46,6 +47,7 @@ def timeit(f, *args, warmup=3, iters=20):
 def main():
     enable_compilation_cache()
     require_tpu()
+    start_stall_watchdog(420)
     record(event="start", device=jax.devices()[0].device_kind)
 
     # 0. dispatch latency: how much does one tunnel round trip cost?
