@@ -98,8 +98,8 @@ def main():
     import horovod_tpu as hvd
 
     enable_compilation_cache()
+    start_stall_watchdog(1200)  # before require_tpu: backend init can hang
     require_tpu()
-    start_stall_watchdog(1200)
     hvd.init()
     record(event="start", device=jax.devices()[0].device_kind)
     ok = 0

@@ -31,8 +31,8 @@ def main():
                        TRAIN_FLOP_MULT, bench_resnet, chip_peak_flops)
 
     enable_compilation_cache()
+    start_stall_watchdog(900)  # before require_tpu: backend init can hang
     require_tpu()
-    start_stall_watchdog(900)
     hvd.init()
     PEAK = chip_peak_flops()
     record(event="start", device=jax.devices()[0].device_kind)

@@ -46,8 +46,8 @@ def timeit(f, *args, warmup=3, iters=20):
 
 def main():
     enable_compilation_cache()
+    start_stall_watchdog(420)  # before require_tpu: backend init can hang
     require_tpu()
-    start_stall_watchdog(420)
     record(event="start", device=jax.devices()[0].device_kind)
 
     # 0. dispatch latency: how much does one tunnel round trip cost?

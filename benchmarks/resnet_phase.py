@@ -37,8 +37,8 @@ def main():
     from horovod_tpu.models import ResNet50
 
     enable_compilation_cache()
+    start_stall_watchdog(900)  # before require_tpu: backend init can hang
     require_tpu()
-    start_stall_watchdog(900)
     hvd.init()
     PEAK = chip_peak_flops()
     record(event="phase_start", device=jax.devices()[0].device_kind)

@@ -127,6 +127,13 @@ def allgather_async(tensor, name: Optional[str] = None,
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
     rt = _runtime()
+    ps = process_set or global_process_set()
+    if not 0 <= int(root_rank) < ps.size:
+        # synchronous, like the reference's HorovodBasics rank check
+        # (test_torch.py test_horovod_broadcast_rank_error)
+        raise ValueError(
+            f"root_rank {root_rank} out of range for process set of size "
+            f"{ps.size}")
     return rt.enqueue(TensorEntry(
         name=name or _default_name("broadcast", tensor), op="broadcast",
         tensor=np.asarray(tensor), root_rank=root_rank, process_set=process_set))

@@ -246,14 +246,113 @@ def synchronize(handle: int):
     return _result_tensor(handle, result)
 
 
+# --- differentiable sync ops ------------------------------------------------
+# The reference's sync collectives are autograd ops (torch/mpi_ops.py
+# HorovodAllreduce/HorovodAllgather/HorovodBroadcast/HorovodAlltoall
+# Function subclasses): hvd.allreduce(x) inside an autograd graph
+# backpropagates a collective of the cotangent. Same gradient math as
+# this repo's TF shim (tensorflow/__init__.py), so the two frameworks
+# agree: allreduce -> allreduce with the same op; allgather ->
+# allreduce-average then this worker's row slice; broadcast ->
+# allreduce-average at the root, zeros elsewhere; alltoall -> alltoall
+# routed back with splits = received_splits.
+
+def _grad_wanted(tensor) -> bool:
+    return torch.is_grad_enabled() and tensor.requires_grad
+
+
+class _AllreduceOp(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale, postscale, ps):
+        ctx.meta = (average, name, op, prescale, postscale, ps)
+        return synchronize(allreduce_async(tensor, average, name, op,
+                                           prescale, postscale, ps))
+
+    @staticmethod
+    def backward(ctx, dy):
+        average, name, op, prescale, postscale, ps = ctx.meta
+        red = allreduce(dy, average=average,
+                        name=f"{name}.grad" if name else None, op=op,
+                        prescale_factor=prescale, postscale_factor=postscale,
+                        process_set=ps)
+        return red, None, None, None, None, None, None
+
+
+class _AllgatherOp(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name, ps):
+        ctx.meta = (name, ps, int(tensor.shape[0]) if tensor.dim() else 0)
+        return synchronize(allgather_async(tensor, name, ps))
+
+    @staticmethod
+    def backward(ctx, dy):
+        name, ps, local_rows = ctx.meta
+        red = allreduce(dy, average=True,
+                        name=f"{name}.grad" if name else None,
+                        process_set=ps)
+        pset = ps or _core.global_process_set()
+        if pset.cross_size <= 1:
+            start = 0
+        else:
+            # ragged inputs: one backward-only exchange of row counts
+            sizes = _core.synchronize(_core.allgather_async(
+                np.asarray([local_rows]),
+                f"{name or 'allgather'}.grad.sizes", process_set=ps))
+            start = int(np.sum(np.asarray(sizes)[:pset.cross_rank]))
+        return red[start:start + local_rows], None, None
+
+
+class _BroadcastOp(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, ps):
+        ctx.meta = (root_rank, name, ps)
+        return synchronize(broadcast_async(tensor, root_rank, name, ps))
+
+    @staticmethod
+    def backward(ctx, dy):
+        root_rank, name, ps = ctx.meta
+        red = allreduce(dy, average=True,
+                        name=f"{name}.grad" if name else None,
+                        process_set=ps)
+        import jax
+
+        pset = ps or _core.global_process_set()
+        is_root = (pset.devices[root_rank].process_index
+                   == jax.process_index())
+        return (red if is_root else red * 0), None, None, None
+
+
+class _AlltoallOp(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, splits, name, ps):
+        out, recv = synchronize(alltoall_async(tensor, splits, name, ps))
+        ctx.meta = (name, ps)
+        ctx.recv = recv
+        ctx.mark_non_differentiable(recv)
+        return out, recv
+
+    @staticmethod
+    def backward(ctx, dy, _drecv=None):
+        name, ps = ctx.meta
+        back, _ = alltoall(dy.contiguous(), splits=ctx.recv,
+                           name=f"{name}.grad" if name else None,
+                           process_set=ps)
+        return back, None, None, None
+
+
 # --- sync wrappers ----------------------------------------------------------
 
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none,
               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
     t, ctx = compression.compress(tensor)
-    out = synchronize(allreduce_async(t, average, name, op, prescale_factor,
-                                      postscale_factor, process_set))
+    if _grad_wanted(t):
+        out = _AllreduceOp.apply(t, average, name, op, prescale_factor,
+                                 postscale_factor, process_set)
+    else:
+        out = synchronize(allreduce_async(t, average, name, op,
+                                          prescale_factor, postscale_factor,
+                                          process_set))
     return compression.decompress(out, ctx)
 
 
@@ -286,10 +385,14 @@ def grouped_allreduce_(tensors, average=None, name=None, op=None,
 
 
 def allgather(tensor, name=None, process_set=None):
+    if _grad_wanted(tensor):
+        return _AllgatherOp.apply(tensor, name, process_set)
     return synchronize(allgather_async(tensor, name, process_set))
 
 
 def broadcast(tensor, root_rank, name=None, process_set=None):
+    if _grad_wanted(tensor):
+        return _BroadcastOp.apply(tensor, root_rank, name, process_set)
     return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
@@ -298,6 +401,8 @@ def broadcast_(tensor, root_rank, name=None, process_set=None):
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
+    if _grad_wanted(tensor):
+        return _AlltoallOp.apply(tensor, splits, name, process_set)
     return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 

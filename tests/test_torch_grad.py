@@ -1,0 +1,158 @@
+"""Autograd through the torch-facing collectives (reference
+test/parallel/test_torch.py test_horovod_allreduce_grad,
+test_horovod_allgather_grad, test_horovod_broadcast_grad,
+test_horovod_alltoall_grad et al.): hvd.allreduce/allgather/broadcast/
+alltoall participate in torch autograd graphs, backpropagating a
+collective of the cotangent with the same math as the TF shim."""
+
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+from horovod_tpu.runner.launch import run_commandline
+
+
+def setup_module(module):
+    hvd.init()
+
+
+def test_allreduce_grad_sum_and_average():
+    x = torch.arange(6, dtype=torch.float32, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum, name="tg.ar.sum")
+    y.sum().backward()
+    # single process: allreduce backward = allreduce(ones) = ones
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(6, np.float32))
+
+    x2 = torch.arange(6, dtype=torch.float32, requires_grad=True)
+    (hvd.allreduce(x2, average=True, name="tg.ar.avg") * 3.0).sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), np.full(6, 3.0, np.float32))
+
+
+def test_allreduce_grad_prescale_postscale():
+    x = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum, name="tg.ar.pre",
+                      prescale_factor=2.0, postscale_factor=0.5)
+    y.sum().backward()
+    # backward rides the same scaling: 2 * 0.5 = 1
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(4, np.float32))
+
+
+def test_allreduce_grad_through_compression():
+    x = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum, name="tg.ar.comp",
+                      compression=hvd.Compression.fp16)
+    assert y.dtype == torch.float32
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(4, np.float32))
+
+
+def test_allgather_grad():
+    x = torch.ones(3, 2, requires_grad=True)
+    out = hvd.allgather(x, name="tg.ag")
+    assert out.shape == (3, 2)  # single process: identity
+    (out * 2.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 2), 2.0))
+
+
+def test_broadcast_grad():
+    x = torch.ones(4, requires_grad=True)
+    out = hvd.broadcast(x, root_rank=0, name="tg.bc")
+    (out * 3.0).sum().backward()
+    # single process IS the root
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 3.0))
+
+
+def test_alltoall_grad():
+    x = torch.arange(4, dtype=torch.float32, requires_grad=True)
+    out, recv = hvd.alltoall(x, name="tg.a2a")
+    assert not recv.requires_grad
+    (out * 5.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 5.0))
+
+
+def test_no_grad_path_unchanged():
+    x = torch.ones(4, requires_grad=True)
+    with torch.no_grad():
+        y = hvd.allreduce(x, op=hvd.Sum, name="tg.nograd")
+    assert not y.requires_grad
+    z = hvd.allreduce(torch.ones(4), op=hvd.Sum, name="tg.noreq")
+    assert not z.requires_grad
+
+
+def test_broadcast_rank_error():
+    """Reference test_horovod_broadcast_rank_error: out-of-range root is a
+    synchronous ValueError, not a wedged negotiation."""
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(torch.ones(2), root_rank=hvd.size() + 7)
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(torch.ones(2), root_rank=-1)
+
+
+GRAD_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    c = float(r + 1)  # rank-dependent cotangent scale
+
+    # allreduce sum: L_r = c_r * sum(y); dL/dx = allreduce(c_r) = 3
+    x = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum, name="g2.ar")
+    np.testing.assert_allclose(y.detach().numpy(), np.full(4, 2.0))
+    (y * c).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 3.0))
+
+    # allreduce average: y = (x0+x1)/2; backward averages the cotangent
+    x = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(x, average=True, name="g2.arav")
+    (y * c).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 1.5))
+
+    # ragged allgather: rank0 contributes 2 rows, rank1 3 rows; the
+    # averaged cotangent comes back sliced to this rank's rows
+    rows = 2 if r == 0 else 3
+    x = torch.full((rows, 2), 1.0, requires_grad=True)
+    out = hvd.allgather(x, name="g2.ag")
+    assert out.shape == (5, 2), out.shape
+    (out * c).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((rows, 2), 1.5))
+
+    # broadcast: root's grad is the averaged cotangent, non-root zeros
+    x = torch.ones(3, requires_grad=True)
+    out = hvd.broadcast(x, root_rank=0, name="g2.bc")
+    (out * c).sum().backward()
+    want = 1.5 if r == 0 else 0.0
+    np.testing.assert_allclose(x.grad.numpy(), np.full(3, want))
+
+    # uneven alltoall: cotangent routes back along received_splits. Row i
+    # of x landed on rank p(i); its grad is c_{p(i)}:
+    #   rank0 rows -> [r0, r1, r1] => grad [1, 2, 2]
+    #   rank1 rows -> [r0, r0, r1] => grad [1, 1, 2]
+    splits = torch.tensor([1, 2]) if r == 0 else torch.tensor([2, 1])
+    x = torch.ones(3, requires_grad=True)
+    out, recv = hvd.alltoall(x, splits=splits, name="g2.a2a")
+    expect_recv = [1, 2] if r == 0 else [2, 1]
+    np.testing.assert_array_equal(recv.numpy(), expect_recv)
+    (out * c).sum().backward()
+    want = [1.0, 2.0, 2.0] if r == 0 else [1.0, 1.0, 2.0]
+    np.testing.assert_allclose(x.grad.numpy(), want)
+
+    print(f"GRAD-WORKER-OK rank {r}")
+""")
+
+
+def test_collective_grads_two_processes(tmp_path):
+    script = tmp_path / "grad_worker.py"
+    script.write_text(GRAD_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
