@@ -34,7 +34,8 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                                  gradient_predivide_factor: float = 1.0,
                                  process_set=None,
                                  backward_passes_per_step: int = 1,
-                                 average_aggregated_gradients: bool = False):
+                                 average_aggregated_gradients: bool = False,
+                                 sparse_as_dense: bool = False):
     import keras
 
     op = _core.Average if op is None else op
@@ -56,6 +57,32 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
     class _Distributed(cls):
         _hvd_wrapped = True
         _hvd_base = cls
+
+        def _hvd_densify(self, grads):
+            """IndexedSlices → dense ahead of the wire. The reference's
+            sparse_as_dense does the same (keras/__init__.py); without
+            the flag it keeps slices sparse on an allgather path — here
+            the embedding-sized gather would still materialize on the
+            host bridge, so dense is the only wire format and a sparse
+            grad without the flag gets a one-time note."""
+            try:
+                import tensorflow as tf
+            except ImportError:
+                return grads
+            out = []
+            for g in grads:
+                if isinstance(g, tf.IndexedSlices):
+                    if not sparse_as_dense and not getattr(
+                            type(self), "_hvd_sparse_warned", False):
+                        type(self)._hvd_sparse_warned = True
+                        import logging
+
+                        logging.getLogger("horovod_tpu").warning(
+                            "sparse gradient densified for the wire; pass "
+                            "sparse_as_dense=True to silence")
+                    g = tf.convert_to_tensor(g)
+                out.append(g)
+            return out
 
         def _hvd_reduce(self, grads):
             n = (process_set or _core.global_process_set()).cross_size
@@ -94,7 +121,7 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
         # is the single funnel — reducing in both would allreduce twice.
 
         def apply(self, grads, trainable_variables=None, **kwargs):
-            grads = list(grads)
+            grads = self._hvd_densify(list(grads))
             if bpps <= 1:
                 grads = self._hvd_reduce(grads)
                 if trainable_variables is None:

@@ -40,7 +40,8 @@ def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
                          gradient_predivide_factor: float = 1.0,
                          process_set=None,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = False):
+                         average_aggregated_gradients: bool = False,
+                         sparse_as_dense: bool = False):
     """Dynamic-subclass optimizer wrap (reference keras/__init__.py:40 →
     _keras/__init__.py:28-166). ``backward_passes_per_step > 1`` turns on
     local gradient aggregation (reference gradient_aggregation.py)."""
@@ -49,6 +50,7 @@ def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
         gradient_predivide_factor=gradient_predivide_factor,
         process_set=process_set,
         backward_passes_per_step=backward_passes_per_step,
+        sparse_as_dense=sparse_as_dense,
         average_aggregated_gradients=average_aggregated_gradients)
 
 

@@ -410,16 +410,26 @@ def reducescatter(tensor, name=None, op=None, process_set=None):
     return synchronize(reducescatter_async(tensor, name, op, process_set))
 
 
-def sparse_allreduce_async(tensor, name, op=Average):
+def sparse_allreduce_async(tensor, name, op=Average,
+                           prescale_factor=1.0, postscale_factor=1.0):
     """Sparse COO reduction via allgather of values+indices (reference
-    torch/mpi_ops.py:512). Returns a thunk that completes the op."""
+    torch/mpi_ops.py:512). Returns a thunk that completes the op.
+    ``prescale_factor``/``postscale_factor`` scale the values around the
+    gather-sum, mirroring the dense allreduce's factors (the allgather +
+    coalesce IS the sum, so pre/post placement is equivalent up to
+    rounding, as in the dense path)."""
     t = tensor.coalesce()
+    values = t.values()
+    if prescale_factor != 1.0:
+        values = values * prescale_factor
     hi = allgather_async(t.indices().t().contiguous(), f"{name}.indices")
-    hv = allgather_async(t.values(), f"{name}.values")
+    hv = allgather_async(values, f"{name}.values")
 
     def finish():
         indices = synchronize(hi).t()
         values = synchronize(hv)
+        if postscale_factor != 1.0:
+            values = values * postscale_factor
         if op == Average:
             # eager collectives contribute per *process* (cross_size), not
             # per chip — divide by the actual number of contributors
@@ -478,12 +488,27 @@ class _DistributedMixin:
 
     def _hvd_setup(self, named_parameters, compression, op,
                    backward_passes_per_step, prescale_factor,
-                   postscale_factor):
+                   postscale_factor, gradient_predivide_factor=1.0,
+                   sparse_as_dense=False):
+        if gradient_predivide_factor != 1.0:
+            if op != Average:
+                # reference optimizer.py:76: predivide splits an Average
+                # into Sum with pre/postscale — meaningless for other ops
+                raise ValueError(
+                    "gradient_predivide_factor requires op=Average")
+            # sum with prescale 1/f, postscale f/n == average, but lets the
+            # user pick where the division happens for numerics
+            op = Sum
+            prescale_factor = prescale_factor / gradient_predivide_factor
+            postscale_factor = (postscale_factor * gradient_predivide_factor
+                                / max(cross_size(), 1))
         self._compression = compression
         self._op = op
         self._bpps = backward_passes_per_step
         self._prescale = prescale_factor
         self._postscale = postscale_factor
+        self._sparse_as_dense = sparse_as_dense
+        self._sparse_thunks: dict[torch.Tensor, object] = {}
         self._handles: dict[torch.Tensor, tuple[int, object]] = {}
         self._passes: dict[torch.Tensor, int] = {}
         self._should_sync = True
@@ -531,7 +556,24 @@ class _DistributedMixin:
         if self._passes[p] < self._bpps:
             return
         self._passes[p] = 0
-        comp, ctx = self._compression.compress(p.grad)
+        self._launch_reduce(p, p.grad)
+
+    def _launch_reduce(self, p, grad):
+        if grad.is_sparse:
+            if self._sparse_as_dense:
+                # reference optimizer.py: densify before the wire
+                grad = grad.to_dense()
+            else:
+                # reference _sparse_allreduce_grad_async: COO values +
+                # indices ride an allgather; completed in synchronize().
+                # The dense path's pre/postscale factors (incl. the
+                # predivide rewrite) apply to the values identically.
+                self._sparse_thunks[p] = sparse_allreduce_async(
+                    grad, name=self._names[p], op=self._op,
+                    prescale_factor=self._prescale,
+                    postscale_factor=self._postscale)
+                return
+        comp, ctx = self._compression.compress(grad)
         h = allreduce_async(comp, name=self._names[p], op=self._op,
                             prescale_factor=self._prescale,
                             postscale_factor=self._postscale)
@@ -545,15 +587,15 @@ class _DistributedMixin:
         # and accumulation counters reset so a mid-window step() doesn't
         # leave stale pass counts.
         for p, name in self._names.items():
-            if not p.requires_grad or p in self._handles:
+            if (not p.requires_grad or p in self._handles
+                    or p in self._sparse_thunks):
                 continue
             if p.grad is None:
                 p.grad = torch.zeros_like(p)
-            comp, ctx = self._compression.compress(p.grad)
-            h = allreduce_async(comp, name=name, op=self._op,
-                                prescale_factor=self._prescale,
-                                postscale_factor=self._postscale)
-            self._handles[p] = (h, ctx)
+            # mid-window sparse grads (bpps>1) take the same sparse route
+            # as the hook — the dense fallback cannot convert COO and
+            # would submit a different collective set than peer ranks
+            self._launch_reduce(p, p.grad)
         for p in self._passes:
             self._passes[p] = 0
         for p, (h, ctx) in list(self._handles.items()):
@@ -561,6 +603,9 @@ class _DistributedMixin:
             p.grad = self._compression.decompress(
                 reduced, ctx).reshape(p.grad.shape).to(p.grad.dtype)
         self._handles.clear()
+        for p, finish in list(self._sparse_thunks.items()):
+            p.grad = finish().to(p.grad.dtype)
+        self._sparse_thunks.clear()
 
     def set_backward_passes_per_step(self, passes: int):
         """Change the local gradient-accumulation window (reference
@@ -592,7 +637,9 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          op=Average,
                          backward_passes_per_step: int = 1,
                          prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0):
+                         postscale_factor: float = 1.0,
+                         gradient_predivide_factor: float = 1.0,
+                         sparse_as_dense: bool = False):
     if hasattr(optimizer, "_hvd_base"):
         # Re-wrapping would make the grafted step() re-enter itself through
         # the newest swapped class (infinite recursion) and register every
@@ -607,7 +654,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     optimizer._hvd_setup(
         list(named_parameters) if named_parameters is not None else None,
         compression, op, backward_passes_per_step,
-        prescale_factor, postscale_factor)
+        prescale_factor, postscale_factor, gradient_predivide_factor,
+        sparse_as_dense)
     return optimizer
 
 

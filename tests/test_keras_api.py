@@ -248,3 +248,41 @@ def test_keras_tensor_functions_and_best_checkpoint(tmp_path):
     model.compile(optimizer="sgd", loss="mse")
     model.fit(x, y, epochs=2, batch_size=16, verbose=0, callbacks=[cb])
     assert (tmp_path / "best.keras").exists()
+
+
+def test_optimizer_from_config_roundtrip():
+    """Reference test_tensorflow2_keras.py test_from_config: the wrapped
+    class reconstructs from its own get_config."""
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(0.002))
+    cfg = opt.get_config()
+    clone = opt.__class__.from_config(cfg)
+    assert type(clone) is type(opt)
+    assert getattr(clone, "_hvd_wrapped", False)
+    np.testing.assert_allclose(float(clone.learning_rate.numpy()
+                                     if hasattr(clone.learning_rate, "numpy")
+                                     else clone.learning_rate), 0.002,
+                               rtol=1e-6)
+    # the clone still reduces: a fit step runs through apply()
+    model = keras.Sequential([keras.layers.Dense(1)])
+    model.compile(optimizer=clone, loss="mse")
+    X, y = _toy_data(32)
+    model.fit(X, y, epochs=1, batch_size=16, verbose=0)
+
+
+def test_sparse_as_dense_embedding_fit():
+    """Reference test_tensorflow2_keras.py test_sparse_as_dense: embedding
+    gradients (IndexedSlices under the TF backend) densify for the wire."""
+    keras.utils.set_random_seed(2)
+    model = keras.Sequential([
+        keras.layers.Embedding(16, 4, input_length=3),
+        keras.layers.Flatten(),
+        keras.layers.Dense(1),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1),
+                                   sparse_as_dense=True)
+    model.compile(optimizer=opt, loss="mse")
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 16, (64, 3))
+    y = rng.randn(64, 1).astype(np.float32)
+    hist = model.fit(X, y, epochs=2, batch_size=16, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]

@@ -156,3 +156,119 @@ def test_collective_grads_two_processes(tmp_path):
     script.write_text(GRAD_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+# --- DistributedOptimizer parity knobs (reference optimizer.py) -------------
+
+def test_gradient_clipping_pattern():
+    """Reference test_gradient_clipping: synchronize() then clip then
+    step() under skip_synchronize()."""
+    w = torch.nn.Parameter(torch.tensor([10.0, -10.0]))
+    opt = torch.optim.SGD([w], lr=1.0)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=[("w", w)])
+    (w * torch.tensor([100.0, 100.0])).sum().backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_([w], 1.0)
+    assert float(w.grad.norm()) <= 1.0 + 1e-5
+    with opt.skip_synchronize():
+        opt.step()
+    # lr=1, clipped grad norm 1: the step moved w by exactly the clipped grad
+    np.testing.assert_allclose(w.detach().numpy(),
+                               [10.0 - 2 ** -0.5, -10.0 - 2 ** -0.5],
+                               rtol=1e-5)
+
+
+def test_gradient_predivide_requires_average():
+    w = torch.nn.Parameter(torch.ones(2))
+    opt = torch.optim.SGD([w], lr=0.1)
+    with pytest.raises(ValueError, match="predivide"):
+        hvd.DistributedOptimizer(opt, named_parameters=[("w", w)],
+                                 op=hvd.Sum, gradient_predivide_factor=2.0)
+
+
+def test_gradient_predivide_matches_average():
+    """predivide=f splits the average into sum * (1/f) pre and (f/n) post —
+    numerically the same gradient as plain average."""
+    results = []
+    for kwargs in ({}, {"gradient_predivide_factor": 2.0}):
+        w = torch.nn.Parameter(torch.tensor([3.0, -1.0]))
+        opt = torch.optim.SGD([w], lr=0.5)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=[(f"w.pd.{len(results)}", w)], **kwargs)
+        (w * torch.tensor([2.0, 4.0])).sum().backward()
+        opt.step()
+        results.append(w.detach().numpy().copy())
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+
+
+def test_sparse_as_dense_and_sparse_path():
+    """Reference sparse_as_dense densifies embedding grads; without it the
+    COO grad rides sparse_allreduce (values+indices allgather)."""
+    for sparse_as_dense in (True, False):
+        emb = torch.nn.Embedding(8, 4, sparse=True)
+        opt = torch.optim.SGD(emb.parameters(), lr=0.5)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=[(f"emb.{sparse_as_dense}", emb.weight)],
+            sparse_as_dense=sparse_as_dense)
+        before = emb.weight.detach().clone()
+        out = emb(torch.tensor([1, 3]))
+        out.sum().backward()
+        opt.step()
+        after = emb.weight.detach()
+        # rows 1 and 3 moved by -lr * 1, others untouched
+        np.testing.assert_allclose(after[1].numpy(),
+                                   (before[1] - 0.5).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(after[0].numpy(), before[0].numpy())
+
+
+def test_sparse_grad_with_backward_passes_per_step():
+    """A sparse grad mid-accumulation-window must ride the sparse path in
+    synchronize(), not crash the dense fallback."""
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = torch.optim.SGD(emb.parameters(), lr=0.5)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=[("emb.bpps", emb.weight)],
+        backward_passes_per_step=2)
+    before = emb.weight.detach().clone()
+    emb(torch.tensor([2])).sum().backward()
+    opt.step()  # window incomplete: hook never fired; synchronize reduces
+    after = emb.weight.detach()
+    np.testing.assert_allclose(after[2].numpy(), (before[2] - 0.5).numpy(),
+                               rtol=1e-6)
+
+
+PREDIVIDE_SPARSE_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+
+    # both ranks touch row 1 with rank-dependent cotangent (r+1); the
+    # predivide-rewritten sparse path must yield the cross-rank AVERAGE
+    # (sum * (1/f) * (f/n) = sum/2 = 1.5), not the raw sum
+    emb = torch.nn.Embedding(4, 2, sparse=True)
+    with torch.no_grad():
+        emb.weight.zero_()
+    opt = torch.optim.SGD(emb.parameters(), lr=1.0)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=[("emb.pd", emb.weight)],
+        gradient_predivide_factor=2.0)
+    (emb(torch.tensor([1])) * float(r + 1)).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(emb.weight.detach().numpy()[1],
+                               np.full(2, -1.5), rtol=1e-6)
+    print(f"PD-SPARSE-OK rank {r}")
+""")
+
+
+def test_sparse_predivide_two_processes(tmp_path):
+    script = tmp_path / "pd_sparse_worker.py"
+    script.write_text(PREDIVIDE_SPARSE_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
