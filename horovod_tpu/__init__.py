@@ -151,9 +151,17 @@ def reducescatter_async(tensor, name: Optional[str] = None, *,
                         op: Optional[ReduceOp] = None,
                         process_set: Optional[ProcessSet] = None) -> int:
     rt = _runtime()
+    arr = np.asarray(tensor)
+    nproc = (process_set or global_process_set()).cross_size
+    if arr.ndim == 0 or arr.shape[0] % max(nproc, 1):
+        # synchronous, like the broadcast rank check: the local shape and
+        # process count fully determine the error — no need to surface it
+        # from the cycle thread as HorovodInternalError
+        raise ValueError("first dim must be divisible by the number of "
+                         f"processes ({arr.shape} over {nproc})")
     return rt.enqueue(TensorEntry(
         name=name or _default_name("reducescatter", tensor), op="reducescatter",
-        tensor=np.asarray(tensor), reduce_op=op or ReduceOp.SUM,
+        tensor=arr, reduce_op=op or ReduceOp.SUM,
         process_set=process_set))
 
 

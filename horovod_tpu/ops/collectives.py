@@ -243,6 +243,15 @@ def _hierarchical_enabled(kind: str) -> bool:
 def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
     xl = _to_local(x)
     nproc = ps.cross_size
+    if xl.size == 0:
+        # zero-element reduction: no device program (XLA normalizes
+        # zero-element arrays to a replicated sharding, which rejects the
+        # P(proc) staging spec); scaling still runs so the output dtype
+        # promotes exactly like the non-empty paths
+        out = jnp.asarray(xl)
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            out = out * prescale_factor * postscale_factor
+        return out
     if nproc == 1:
         out = xl if isinstance(xl, jax.Array) else xl.astype(xl.dtype)
         if prescale_factor != 1.0 or postscale_factor != 1.0:
@@ -338,6 +347,8 @@ def _eager_allgather(x, ps: ProcessSet):
         _eager_allgather_fixed(np.array([xl.shape[0]], np.int64), ps)
     )).reshape(-1)
     maxn = int(sizes.max())
+    if maxn == 0:
+        return jnp.asarray(_to_local_np(xl))  # nobody has rows
     if int(sizes.min()) == maxn:
         # even case (the overwhelmingly common one): no pad/compact —
         # a device-resident payload stays on device
@@ -400,7 +411,7 @@ def _eager_allgather_fixed(xl: np.ndarray, ps: ProcessSet):
 
 def _eager_broadcast(x, root_rank: int, ps: ProcessSet):
     xl = _to_local(x)  # device-resident inputs stay on device
-    if ps.cross_size == 1:
+    if ps.cross_size == 1 or xl.size == 0:
         return jnp.asarray(xl)
     # map root chip rank -> owning process row
     root_proc = ps._proc_indices.index(ps.devices[root_rank].process_index)
@@ -437,6 +448,10 @@ def _eager_alltoall(x, splits, ps: ProcessSet):
     me = ps.cross_rank
     recv_splits = split_mat[:, me]
     maxs = int(split_mat.max())
+    if maxs == 0:
+        # all splits zero (reference test alltoall_empty): nothing moves
+        return (jnp.asarray(np.zeros((0,) + xl.shape[1:], xl.dtype)),
+                jnp.asarray(recv_splits))
     send = np.zeros((nproc, maxs) + xl.shape[1:], xl.dtype)
     offs = np.concatenate([[0], np.cumsum(splits)])
     for p in range(nproc):

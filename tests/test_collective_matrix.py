@@ -360,3 +360,75 @@ def test_negotiation_fuzz_soak(tmp_path):
     script.write_text(FUZZ_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+EDGE_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+
+    # reference test_tensorflow.py alltoall_zero_splits / one_rank_sends_
+    # nothing / one_rank_receives_nothing: zero-length segments are legal
+    # 1. rank 0 sends nothing at all; rank 1 sends 2 rows to each
+    x = np.zeros((0, 3), np.float32) if r == 0 else \
+        np.arange(12, dtype=np.float32).reshape(4, 3)
+    splits = np.array([0, 0]) if r == 0 else np.array([2, 2])
+    out, recv = hvd.synchronize(hvd.alltoall_async(x, splits, name="e.a2a1"))
+    out, recv = np.asarray(out), np.asarray(recv)
+    np.testing.assert_array_equal(recv, [0, 2])
+    assert out.shape == (2, 3), out.shape
+    want = np.arange(12, dtype=np.float32).reshape(4, 3)[:2] if r == 0 \
+        else np.arange(12, dtype=np.float32).reshape(4, 3)[2:]
+    np.testing.assert_array_equal(out, want)
+
+    # 2. rank 1 receives nothing: both ranks send only to rank 0
+    x = np.full((2,), float(r + 1), np.float32)
+    out, recv = hvd.synchronize(
+        hvd.alltoall_async(x, np.array([2, 0]), name="e.a2a2"))
+    out, recv = np.asarray(out), np.asarray(recv)
+    if r == 0:
+        np.testing.assert_array_equal(recv, [2, 2])
+        np.testing.assert_array_equal(out, [1.0, 1.0, 2.0, 2.0])
+    else:
+        np.testing.assert_array_equal(recv, [0, 0])
+        assert out.shape == (0,), out.shape
+
+    # 3. fully empty exchange (reference alltoall_empty)
+    out, recv = hvd.synchronize(hvd.alltoall_async(
+        np.zeros((0, 2), np.float32), np.array([0, 0]), name="e.a2a3"))
+    assert np.asarray(out).shape == (0, 2)
+
+    # 4. ragged allgather where one rank contributes zero rows
+    x = np.zeros((0, 2), np.float32) if r == 0 else np.ones((3, 2), np.float32)
+    out = np.asarray(hvd.synchronize(hvd.allgather_async(x, name="e.ag0")))
+    np.testing.assert_array_equal(out, np.ones((3, 2), np.float32))
+
+    # 5. reducescatter with an empty trailing dim keeps first-dim split
+    out = np.asarray(hvd.synchronize(hvd.reducescatter_async(
+        np.zeros((4, 0), np.float32), name="e.rs0")))
+    assert out.shape == (2, 0), out.shape
+    try:
+        hvd.synchronize(hvd.reducescatter_async(
+            np.zeros((3, 0), np.float32), name="e.rs1"))
+        raise SystemExit("expected divisibility error")
+    except ValueError:
+        pass
+
+    print(f"EDGE-WORKER-OK rank {r}")
+""")
+
+
+def test_alltoall_allgather_zero_size_edges(tmp_path):
+    """Zero-length alltoall segments and zero-row allgather contributions
+    (reference test_tensorflow.py alltoall_zero_splits, alltoall_empty,
+    one_rank_sends/receives_nothing, allgather variable size with 0)."""
+    script = tmp_path / "edge_worker.py"
+    script.write_text(EDGE_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
