@@ -129,6 +129,8 @@ class KVController:
     # re-serialized, re-validated message list.
     SAME_AS_LAST = b"="
 
+    on_params = None  # callable(dict) applied at response receipt
+
     def __init__(self, client, rank: int, size: int,
                  poll_timeout: float = RESPONSE_TIMEOUT_S,
                  stall_warning_s: float = 60.0,
@@ -197,7 +199,25 @@ class KVController:
         resp.setdefault("errors", {})
         resp.setdefault("sigs", {})
         resp.setdefault("join_done", None)
+        if resp.get("params") is not None and self.on_params is not None:
+            # reference SynchronizeParameters (controller.cc:39-53): tuned
+            # knobs ride the response, so every rank applies them at the
+            # same point relative to the round's collectives — an
+            # asynchronously-applied hierarchical flag would make ranks
+            # build DIFFERENT programs for the same negotiated tensor
+            try:
+                self.on_params(resp["params"])
+            except Exception as e:  # tuning must never break the lockstep
+                LOG.warning("on_params failed: %s", e)
         return resp
+
+    def submit_params(self, params: dict):
+        """Rank 0 only: hand tuned knobs to the coordinator; they ride the
+        next response and apply on every rank via ``on_params``."""
+        if self._coord is not None:
+            self._coord.set_params(params)
+        elif self.on_params is not None:
+            self.on_params(params)
 
     def stop(self):
         if self._coord:
@@ -228,6 +248,8 @@ class _Coordinator(threading.Thread):
         self.table: dict[str, tuple[list, set[int]]] = {}
         self.order: list[str] = []  # rank-0-submission-order tie break
         self.errors: dict[str, str] = {}
+        self._pending_params = None
+        self._params_lock = threading.Lock()
         # rank -> last full submission (for SAME_AS_LAST fast-path decode)
         self._last_submission: dict[int, dict] = {}
         # join tracking (reference JoinOp: joined_size / joined ranks,
@@ -244,6 +266,10 @@ class _Coordinator(threading.Thread):
     # multi-minute block (the round-1 weakness: the coordinator waited
     # forever without saying which rank was missing).
     POLL_TIMEOUT_S = 1.0
+
+    def set_params(self, params: dict):
+        with self._params_lock:
+            self._pending_params = params
 
     def _warn_stall(self, round_no: int, missing: set[int], elapsed: float):
         waiting = {
@@ -369,11 +395,14 @@ class _Coordinator(threading.Thread):
                     self.errors.pop(n, None)
                     self._first_seen.pop(n, None)
                     self._stall_warned.discard(n)
+                resp_dict = {"ready": ready, "sigs": sigs,
+                             "errors": errors, "join_done": join_done}
+                with self._params_lock:
+                    if self._pending_params is not None:
+                        resp_dict["params"] = self._pending_params
+                        self._pending_params = None
                 self.client.put(_ctl_scope(r), "resp",
-                                json.dumps({"ready": ready,
-                                            "sigs": sigs,
-                                            "errors": errors,
-                                            "join_done": join_done}).encode())
+                                json.dumps(resp_dict).encode())
                 resp_published = True
                 if r >= 2:
                     self.client.delete_scope(_ctl_scope(r - 2))

@@ -191,11 +191,34 @@ class BackgroundRuntime:
         self._join_done_evt = threading.Event()
         self._join_last_rank = -1
         self.controller = self._maybe_controller()
+        if self.controller is not None:
+            self.controller.on_params = self._apply_tuned_params
         if self.controller is not None and self.stall is not None:
             # multi-process: the coordinator owns stall *shutdown* (it can
             # attribute the missing ranks — reference stall_inspector runs
             # coordinator-side); the local inspector keeps the warning role
             self.stall.shutdown_time_s = 0.0
+
+    def _apply_tuned_params(self, p: dict):
+        """Apply coordinator-synchronized tuning knobs (reference
+        SynchronizeParameters): called from negotiate() at response
+        receipt, so every rank switches knobs at the same round boundary
+        relative to the collectives it executes."""
+        try:
+            self.fusion_threshold = int(p["fusion"])
+            self.cycle_time_ms = float(p["cycle"])
+            if "hier_ar" in p or "hier_ag" in p:
+                from ..common import context as ctx_mod
+
+                cfg = ctx_mod.context().config
+                cfg.hierarchical_allreduce = bool(
+                    p.get("hier_ar", cfg.hierarchical_allreduce))
+                cfg.hierarchical_allgather = bool(
+                    p.get("hier_ag", cfg.hierarchical_allgather))
+        finally:
+            at = self.autotuner
+            if at is not None and p.get("final"):
+                at.done = True
 
     def _maybe_controller(self):
         """Cross-process negotiation over the launcher's rendezvous store —

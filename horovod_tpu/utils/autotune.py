@@ -37,9 +37,15 @@ import numpy as np
 
 LOG = logging.getLogger("horovod_tpu")
 
-# log2-space bounds: fusion 1 MiB .. 256 MiB, cycle 0.5 .. 25 ms
+# log2-space bounds: fusion 1 MiB .. 256 MiB, cycle 0.5 .. 25 ms.
+# Dims 2-3 are the categorical knobs the reference's ParameterManager
+# also tunes (parameter_manager.h:42 hierarchical allreduce/allgather):
+# relaxed to [0,1] in the GP and thresholded at 0.5 when applied — the
+# continuous relaxation plays the role of the reference's categorical
+# grid, sharing one surrogate across both settings.
 _BOUNDS = np.array([[20.0, 28.0],
                     [math.log2(0.5), math.log2(25.0)]])
+_DIMS = 4
 
 
 class _GP:
@@ -116,16 +122,20 @@ class BayesianOptimizer:
         return self.X[int(np.argmax(self.y))]
 
 
-def _to_params(x01: np.ndarray) -> tuple[int, float]:
+def _to_params(x01: np.ndarray) -> tuple[int, float, bool, bool]:
     lo, hi = _BOUNDS[:, 0], _BOUNDS[:, 1]
-    logs = lo + np.clip(x01, 0, 1) * (hi - lo)
-    return int(2.0 ** logs[0]), float(2.0 ** logs[1])
+    logs = lo + np.clip(x01[:2], 0, 1) * (hi - lo)
+    return (int(2.0 ** logs[0]), float(2.0 ** logs[1]),
+            bool(x01[2] >= 0.5), bool(x01[3] >= 0.5))
 
 
-def _from_params(fusion: int, cycle: float) -> np.ndarray:
+def _from_params(fusion: int, cycle: float,
+                 hier_ar: bool, hier_ag: bool) -> np.ndarray:
     lo, hi = _BOUNDS[:, 0], _BOUNDS[:, 1]
     logs = np.array([math.log2(max(fusion, 1)), math.log2(max(cycle, 1e-3))])
-    return np.clip((logs - lo) / (hi - lo), 0, 1)
+    cont = np.clip((logs - lo) / (hi - lo), 0, 1)
+    return np.concatenate([cont, [0.75 if hier_ar else 0.25,
+                                  0.75 if hier_ag else 0.25]])
 
 
 class Autotuner:
@@ -136,9 +146,6 @@ class Autotuner:
     updates the GP and proposes; other ranks poll + apply.
     """
 
-    SCOPE = "autotune"
-    KEY = "latest"
-
     def __init__(self, runtime, log_path: str = "", warmup_samples: int = 3,
                  max_samples: int = 20):
         self.runtime = runtime
@@ -148,15 +155,15 @@ class Autotuner:
         self._samples = 0
         self._last_bytes = 0
         self._last_time = time.monotonic()
-        self._seq_applied = -1
         self.done = False
+        self._final_submitted = False
         ctl = runtime.controller
-        self._client = ctl.client if ctl is not None else None
         self._rank = ctl.rank if ctl is not None else 0
-        self._opt = BayesianOptimizer() if self._rank == 0 else None
+        self._opt = (BayesianOptimizer(dims=_DIMS)
+             if self._rank == 0 else None)
         if log_path:
             with open(log_path, "w") as f:
-                f.write("sample,fusion_bytes,cycle_ms,score_bytes_per_sec\n")
+                f.write("sample,fusion_bytes,cycle_ms,hier_allreduce,hier_allgather,score_bytes_per_sec\n")
 
     # -- scoring ------------------------------------------------------------
     def _score(self) -> Optional[float]:
@@ -169,53 +176,69 @@ class Autotuner:
         self._last_time = now
         return db / dt
 
+    @staticmethod
+    def _get_hier() -> tuple[bool, bool]:
+        from horovod_tpu.common import context as ctx_mod
+
+        cfg = ctx_mod.context().config
+        return cfg.hierarchical_allreduce, cfg.hierarchical_allgather
+
+    @staticmethod
+    def _set_hier(hier_ar: bool, hier_ag: bool):
+        from horovod_tpu.common import context as ctx_mod
+
+        cfg = ctx_mod.context().config
+        cfg.hierarchical_allreduce = bool(hier_ar)
+        cfg.hierarchical_allgather = bool(hier_ag)
+
     def _log(self, score: float):
         if self.log_path:
+            ar, ag = self._get_hier()
             with open(self.log_path, "a") as f:
                 f.write(f"{self._samples},{self.runtime.fusion_threshold},"
-                        f"{self.runtime.cycle_time_ms},{score:.1f}\n")
+                        f"{self.runtime.cycle_time_ms},{int(ar)},{int(ag)},"
+                        f"{score:.1f}\n")
 
     # -- parameter broadcast (SynchronizeParameters, controller.cc:39-53) ---
-    def _publish(self, fusion: int, cycle: float, final: bool):
-        self._seq_applied += 1
-        payload = json.dumps({"seq": self._seq_applied, "fusion": fusion,
-                              "cycle": cycle, "final": final}).encode()
-        if self._client is not None:
-            try:
-                self._client.put(self.SCOPE, self.KEY, payload)
-            except Exception as e:
-                LOG.warning("autotune publish failed: %s", e)
-
-    def poll_params(self) -> bool:
-        """Non-root: apply the coordinator's latest proposal if newer.
-        Returns True when an update was applied. Public so tests and
-        framework loops can force a final sync."""
-        if self._client is None or self._rank == 0:
-            return False
-        try:
-            raw = self._client.get(self.SCOPE, self.KEY, timeout=0.05)
-        except Exception:
-            return False
-        msg = json.loads(raw)
-        if msg["seq"] <= self._seq_applied:
-            return False
-        self._seq_applied = msg["seq"]
-        self.runtime.fusion_threshold = int(msg["fusion"])
-        self.runtime.cycle_time_ms = float(msg["cycle"])
-        if msg.get("final"):
+    def _submit(self, fusion: int, cycle: float, hier_ar: bool,
+                hier_ag: bool, final: bool):
+        """Hand the proposal to the coordinator: it rides the next
+        negotiated response and applies on EVERY rank (this one included)
+        at response receipt — never asynchronously, because a per-rank
+        divergence in the hierarchical flags would build different XLA
+        programs for the same negotiated tensor and corrupt the wire."""
+        params = {"fusion": int(fusion), "cycle": float(cycle),
+                  "hier_ar": bool(hier_ar), "hier_ag": bool(hier_ag),
+                  "final": bool(final)}
+        ctl = self.runtime.controller
+        if ctl is not None:
+            ctl.submit_params(params)
+            return
+        self.runtime.fusion_threshold = params["fusion"]
+        self.runtime.cycle_time_ms = params["cycle"]
+        ps = getattr(self.runtime, "process_set", None)
+        if ps is None or ps.cross_size == 1:
+            # truly single process: no lockstep to protect
+            self._set_hier(params["hier_ar"], params["hier_ag"])
+        # else: multi-process WITHOUT a rendezvous store (name-ordered
+        # fallback) — every rank tunes its own fusion/cycle locally
+        # (survivable: the coordinator-less path doesn't fuse across
+        # ranks), but the hierarchical flags change the XLA program
+        # shape and MUST NOT diverge, so they stay untouched here
+        if final:
             self.done = True
-        return True
 
     # -- main entry ---------------------------------------------------------
     def sample(self):
         if self._rank != 0:
-            self.poll_params()
+            # params arrive via the negotiated response
+            # (runtime._apply_tuned_params); nothing to poll
             score = self._score()
             if score is not None:
                 self._samples += 1
                 self._log(score)
             return
-        if self.done:
+        if self.done or self._final_submitted:
             return
         score = self._score()
         if score is None:
@@ -224,19 +247,17 @@ class Autotuner:
         self._log(score)
         if self._samples <= self.warmup:
             return
+        ar_now, ag_now = self._get_hier()
         x_now = _from_params(self.runtime.fusion_threshold,
-                             self.runtime.cycle_time_ms)
+                             self.runtime.cycle_time_ms, ar_now, ag_now)
         self._opt.observe(x_now, score)
         if self._samples >= self.max_samples + self.warmup:
-            fusion, cycle = _to_params(self._opt.best())
-            self.runtime.fusion_threshold = fusion
-            self.runtime.cycle_time_ms = cycle
-            self._publish(fusion, cycle, final=True)
-            self.done = True
-            LOG.info("autotune converged: fusion=%d cycle=%.2fms",
-                     fusion, cycle)
+            fusion, cycle, hier_ar, hier_ag = _to_params(self._opt.best())
+            self._submit(fusion, cycle, hier_ar, hier_ag, final=True)
+            self._final_submitted = True
+            LOG.info("autotune converged: fusion=%d cycle=%.2fms "
+                     "hier_ar=%s hier_ag=%s", fusion, cycle, hier_ar,
+                     hier_ag)
             return
-        fusion, cycle = _to_params(self._opt.suggest())
-        self.runtime.fusion_threshold = fusion
-        self.runtime.cycle_time_ms = cycle
-        self._publish(fusion, cycle, final=False)
+        fusion, cycle, hier_ar, hier_ag = _to_params(self._opt.suggest())
+        self._submit(fusion, cycle, hier_ar, hier_ag, final=False)

@@ -348,23 +348,32 @@ AUTOTUNE_WORKER = textwrap.dedent("""
         out = np.asarray(hvd.synchronize(hvd.allreduce_async(
             np.ones(256, np.float32), op=hvd.Sum, name="tune.g")))
         assert np.allclose(out, 2.0)
-    # rank 0 publishes its final (best) params; give rank 1 a beat to see
-    # them, then force one last poll (a framework loop would keep sampling)
+    # the final (best) params ride a negotiated response; keep issuing
+    # rounds until both ranks have applied them
     deadline = time.time() + 20
+    i = 0
     while time.time() < deadline and not at.done:
-        at.poll_params() if r != 0 else None
-        time.sleep(0.1)
+        out = np.asarray(hvd.synchronize(hvd.allreduce_async(
+            np.ones(256, np.float32), op=hvd.Sum, name=f"tune.t{i}")))
+        i += 1
+        time.sleep(0.05)
     assert at.done, (r, at._samples)
-    knobs = hvd.allgather_object((rt.fusion_threshold, rt.cycle_time_ms))
-    assert knobs[0] == knobs[1], knobs  # identical on all ranks
+    cfg = ctx_mod.context().config
+    knobs = hvd.allgather_object((rt.fusion_threshold, rt.cycle_time_ms,
+                                  cfg.hierarchical_allreduce,
+                                  cfg.hierarchical_allgather))
+    assert knobs[0] == knobs[1], knobs  # identical on all ranks incl. hier
     print("autotune sync OK", r, knobs[0])
 """)
 
 
 def test_autotune_synchronized_across_ranks(tmp_path):
-    """Reference SynchronizeParameters (controller.cc:39-53): the
-    coordinator's winning fusion/cycle knobs reach every rank — no
-    per-process divergence."""
+    """Reference SynchronizeParameters (controller.cc:39-53): tuned knobs
+    (fusion, cycle, AND the categorical hierarchical flags the reference's
+    ParameterManager also tunes) ride the negotiated response and apply on
+    every rank at the same round boundary — an asynchronously-applied
+    hierarchical flag would build different XLA programs for the same
+    negotiated tensor (caught live as a gloo wire mismatch)."""
     script = tmp_path / "worker.py"
     script.write_text(AUTOTUNE_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
