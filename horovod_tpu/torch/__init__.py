@@ -513,35 +513,8 @@ class _DistributedMixin:
         self._passes: dict[torch.Tensor, int] = {}
         self._should_sync = True
         self._hook_handles = []
-        if named_parameters is not None:
-            seen, dups = set(), set()
-            for n, _ in named_parameters:
-                if n in seen:
-                    dups.add(n)
-                seen.add(n)
-            if dups:
-                # duplicate names would issue allreduces under the same
-                # negotiation name and mis-fuse across ranks (reference
-                # optimizer.py find_duplicates raises the same way)
-                raise ValueError(
-                    "named_parameters contains duplicate names: "
-                    f"{sorted(dups)}")
-            names = {p: n for n, p in named_parameters}
-            all_params = {p for g in self.param_groups for p in g["params"]}
-            missing = all_params - names.keys()
-            if missing:
-                # reference optimizer.py raises when named_parameters does
-                # not cover the optimizer — uncovered params would silently
-                # skip reduction and diverge across workers
-                raise ValueError(
-                    "named_parameters does not cover all optimizer "
-                    f"parameters ({len(missing)} uncovered)")
-        else:
-            names = {}
-            for gi, group in enumerate(self.param_groups):
-                for pi, p in enumerate(group["params"]):
-                    names[p] = f"allreduce.noname.{gi}.{pi}"
-        self._names = names
+        self._names = names = _build_param_names(
+            self, named_parameters, "allreduce")
         for p in names:
             if p.requires_grad:
                 self._passes[p] = 0
@@ -631,6 +604,131 @@ class _DistributedMixin:
         return self._hvd_base.step(self, closure)
 
 
+def _build_param_names(optimizer, named_parameters, noname_prefix):
+    """Shared name validation (reference optimizer.py find_duplicates +
+    unnamed-params check): duplicates would issue collectives under one
+    negotiation name and mis-fuse across ranks; uncovered params would
+    silently never reduce (or, in the Adasum path, never step)."""
+    if named_parameters is not None:
+        seen, dups = set(), set()
+        for n, _ in named_parameters:
+            if n in seen:
+                dups.add(n)
+            seen.add(n)
+        if dups:
+            raise ValueError(
+                "named_parameters contains duplicate names: "
+                f"{sorted(dups)}")
+        names = {p: n for n, p in named_parameters}
+        all_params = {p for g in optimizer.param_groups for p in g["params"]}
+        missing = all_params - names.keys()
+        if missing:
+            raise ValueError(
+                "named_parameters does not cover all optimizer "
+                f"parameters ({len(missing)} uncovered)")
+        return names
+    names = {}
+    for gi, group in enumerate(optimizer.param_groups):
+        for pi, p in enumerate(group["params"]):
+            names[p] = f"{noname_prefix}.noname.{gi}.{pi}"
+    return names
+
+
+class _AdasumMixin:
+    """Delta-Adasum optimizer (reference torch/optimizer.py:329
+    _DistributedAdasumOptimizer): each parameter's hook runs the LOCAL
+    base-optimizer step for that parameter immediately, forming
+    delta = p_after_step - p_before_step; deltas are combined across
+    workers with the scale-invariant Adasum reduction and committed as
+    p = start + adasum(delta). Same model-combining semantics as this
+    repo's TF DistributedAdasumOptimizer."""
+
+    def _hvd_adasum_setup(self, named_parameters, compression,
+                          backward_passes_per_step):
+        self._compression = compression
+        self._bpps = int(backward_passes_per_step)
+        self._passes: dict[torch.Tensor, int] = {}
+        self._handles: dict[torch.Tensor, tuple] = {}
+        self._starts: dict[torch.Tensor, torch.Tensor] = {}
+        self._hook_handles = []
+        self._names = _build_param_names(self, named_parameters, "adasum")
+        for p in self._names:
+            if p.requires_grad:
+                self._passes[p] = 0
+                self._starts[p] = torch.zeros_like(p.data)
+                self._hook_handles.append(
+                    p.register_post_accumulate_grad_hook(self._hvd_delta_hook))
+
+    def _hvd_local_step_delta(self, p):
+        """Run the base optimizer on ONLY this param, then turn p into the
+        delta (reference _allreduce_grad_async, optimizer.py:397-439)."""
+        start = self._starts[p]
+        start.copy_(p.data)
+        stashed = []
+        for group in self.param_groups:
+            stashed.append(group["params"])
+            group["params"] = [p] if any(p is v for v in group["params"]) \
+                else []
+        try:
+            self._hvd_base.step(self)
+        finally:
+            for params, group in zip(stashed, self.param_groups):
+                group["params"] = params
+        p.data.sub_(start)  # p now holds delta = -alpha * f(g)
+        comp, ctx = self._compression.compress(p.data)
+        h = allreduce_async(comp, name=self._names[p], op=Adasum)
+        self._handles[p] = (h, ctx)
+
+    def _hvd_delta_hook(self, p):
+        self._passes[p] += 1
+        if self._passes[p] < self._bpps:
+            return
+        self._passes[p] = 0
+        self._hvd_local_step_delta(p)
+
+    def synchronize(self):
+        """Reference optimizer.py:460: a separate synchronize is
+        meaningless for the delta optimizer (step commits)."""
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using Adasum "
+            "optimizer.")
+
+    def set_backward_passes_per_step(self, passes: int):
+        self._bpps = int(passes)
+        for p in self._passes:
+            self._passes[p] = 0
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        # symmetric collective set: params whose hook did not fire this
+        # step contribute a zero delta (Adasum of a zero vector adds
+        # nothing but keeps all ranks' submissions aligned)
+        for p in self._names:
+            if p.requires_grad and p not in self._handles:
+                self._hvd_local_step_delta(p) if p.grad is not None \
+                    else self._hvd_zero_delta(p)
+        for p, (h, ctx) in list(self._handles.items()):
+            reduced = synchronize(h)
+            delta = self._compression.decompress(reduced, ctx) \
+                .reshape(p.data.shape).to(p.data.dtype)
+            p.data.copy_(self._starts[p] + delta)
+        self._handles.clear()
+        for p in self._passes:
+            self._passes[p] = 0
+        return loss
+
+    def _hvd_zero_delta(self, p):
+        start = self._starts[p]
+        start.copy_(p.data)
+        p.data.zero_()
+        comp, ctx = self._compression.compress(p.data)
+        h = allreduce_async(comp, name=self._names[p], op=Adasum)
+        self._handles[p] = (h, ctx)
+
+
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          compression=Compression.none,
@@ -647,6 +745,23 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
         raise ValueError(
             "optimizer is already wrapped by DistributedOptimizer")
     base = optimizer.__class__
+    if op == Adasum and cross_size() > 1:
+        # reference optimizer.py:576: Adasum selects the delta optimizer
+        # (size()==1 degenerates to the regular wrapper there and here)
+        if (gradient_predivide_factor != 1.0 or prescale_factor != 1.0
+                or postscale_factor != 1.0 or sparse_as_dense):
+            raise ValueError(
+                "gradient_predivide_factor/prescale/postscale/"
+                "sparse_as_dense are not supported with op=Adasum")
+        body = {k: v for k, v in _AdasumMixin.__dict__.items()
+                if not k.startswith("__")}
+        body["_hvd_base"] = base
+        optimizer.__class__ = type("DistributedAdasum" + base.__name__,
+                                   (base,), body)
+        optimizer._hvd_adasum_setup(
+            list(named_parameters) if named_parameters is not None else None,
+            compression, backward_passes_per_step)
+        return optimizer
     body = {k: v for k, v in _DistributedMixin.__dict__.items()
             if not k.startswith("__")}
     body["_hvd_base"] = base

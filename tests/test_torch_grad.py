@@ -272,3 +272,58 @@ def test_sparse_predivide_two_processes(tmp_path):
     script.write_text(PREDIVIDE_SPARSE_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+ADASUM_OPT_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+
+    # orthogonal local deltas: adasum == sum. SGD lr=0.5, grad = e_r
+    # -> local delta_r = -0.5 * e_r -> committed p = p0 - 0.5*(e0+e1)
+    w = torch.nn.Parameter(torch.zeros(2))
+    opt = torch.optim.SGD([w], lr=0.5)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=[("w.orth", w)],
+                                   op=hvd.Adasum)
+    g = torch.tensor([1.0, 0.0]) if r == 0 else torch.tensor([0.0, 1.0])
+    (w * g).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.detach().numpy(), [-0.5, -0.5], atol=1e-6)
+
+    # identical local deltas: adasum == average (scale-invariance)
+    w2 = torch.nn.Parameter(torch.zeros(3))
+    opt2 = torch.optim.SGD([w2], lr=1.0)
+    opt2 = hvd.DistributedOptimizer(opt2, named_parameters=[("w.same", w2)],
+                                    op=hvd.Adasum)
+    (w2 * torch.tensor([2.0, 2.0, 2.0])).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(w2.detach().numpy(), [-2.0, -2.0, -2.0],
+                               atol=1e-5)
+
+    # skip_synchronize must refuse (reference optimizer.py:465)
+    try:
+        with opt2.skip_synchronize():
+            pass
+        raise SystemExit("skip_synchronize should raise for Adasum")
+    except AssertionError:
+        pass
+    print(f"ADASUM-OPT-OK rank {r}")
+""")
+
+
+def test_adasum_delta_optimizer_two_processes(tmp_path):
+    """Reference test_delta_optimizer: DistributedOptimizer(op=Adasum)
+    runs the local step per-parameter, adasum-combines the DELTAS, and
+    commits p = start + adasum(delta): orthogonal deltas sum, identical
+    deltas average."""
+    script = tmp_path / "adasum_opt_worker.py"
+    script.write_text(ADASUM_OPT_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
