@@ -32,7 +32,8 @@ NEG_INF = -1e30
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                       acc_scr, m_scr, l_scr, *, scale: float, causal: bool,
-                      block_q: int, block_k: int, num_k_blocks: int):
+                      causal_offset: int, block_q: int, block_k: int,
+                      num_k_blocks: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -49,11 +50,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
+            # causal_offset=0: standard (row >= col); =1: STRICT (row > col)
+            # — striped ring attention's j>i rounds exclude the diagonal
             rows = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols + causal_offset, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -65,8 +68,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         m_scr[:] = m_new
 
     if causal:
-        # skip blocks strictly above the diagonal (their mask is empty)
-        @pl.when(ki * block_k < (qi + 1) * block_q)
+        # skip blocks whose mask is entirely empty
+        @pl.when(ki * block_k + causal_offset < (qi + 1) * block_q)
         def _():
             _block()
     else:
@@ -86,8 +89,10 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "causal_offset"))
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               causal_offset: int = 0):
     """q: [B, sq, d], k/v: [B, sk, d] → (o [B, sq, d], m [B, sq], l [B, sq]).
 
     o is *normalized* (already divided by l); combining across ring steps
@@ -106,8 +111,9 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
     scale = d ** -0.5
 
     kernel = functools.partial(
-        _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq,
-        block_k=bk, num_k_blocks=nk)
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        causal_offset=causal_offset, block_q=bq, block_k=bk,
+        num_k_blocks=nk)
     from jax.experimental.pallas import tpu as pltpu
 
     o, m, l = pl.pallas_call(
@@ -138,13 +144,14 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
     return o, m, l
 
 
-def _reference_attention(q, k, v, causal: bool):
+def _reference_attention(q, k, v, causal: bool, causal_offset: int = 0):
     """Plain XLA attention used by the backward rematerialization and as
     the numerics oracle in tests. q/k/v: [B, s, d]."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool),
+                        k=-causal_offset)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
@@ -164,7 +171,7 @@ def flash_attention_stats(q, k, v, causal: bool = True, block_q: int = 512,
     return _flash_fwd(q, k, v, causal, block_q, block_k)
 
 
-def _lax_stats(q, k, v, causal: bool):
+def _lax_stats(q, k, v, causal: bool, causal_offset: int = 0):
     """Pure-XLA stats attention: (normalized o, running max m, sum l) in the
     same contract as the Pallas kernel. Serves as the differentiable
     fallback (non-TPU backends) and the autodiff oracle for the kernel's
@@ -172,7 +179,8 @@ def _lax_stats(q, k, v, causal: bool):
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool),
+                        k=-causal_offset)
         s = jnp.where(mask, s, NEG_INF)
     m = s.max(axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -182,24 +190,25 @@ def _lax_stats(q, k, v, causal: bool):
     return o, m, l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def attention_stats(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512):
+                    block_k: int = 512, causal_offset: int = 0):
     """Differentiable stats attention: Pallas kernel on TPU for the primal,
     rematerialized XLA VJP for the backward (cotangents of o, m, l all
     handled — ring combination makes m and l real outputs, not residuals).
     """
-    return _flash_fwd(q, k, v, causal, block_q, block_k)
+    return _flash_fwd(q, k, v, causal, block_q, block_k, causal_offset)
 
 
-def _stats_fwd(q, k, v, causal, block_q, block_k):
-    out = _flash_fwd(q, k, v, causal, block_q, block_k)
+def _stats_fwd(q, k, v, causal, block_q, block_k, causal_offset):
+    out = _flash_fwd(q, k, v, causal, block_q, block_k, causal_offset)
     return out, (q, k, v)
 
 
-def _stats_bwd(causal, block_q, block_k, res, cts):
+def _stats_bwd(causal, block_q, block_k, causal_offset, res, cts):
     q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _lax_stats(a, b, c, causal), q, k, v)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _lax_stats(a, b, c, causal, causal_offset), q, k, v)
     return vjp(cts)
 
 

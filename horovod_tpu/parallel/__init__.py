@@ -2,7 +2,13 @@ from .mesh import create_mesh, create_hierarchical_mesh, parse_mesh_spec  # noqa
 from .dp import data_parallel_step, shard_batch  # noqa: F401
 from .tp import (column_parallel_dense, row_parallel_dense, parallel_mlp,  # noqa: F401
                  parallel_attention_output, shard_leading)
-from .sp import ring_attention, ulysses_attention  # noqa: F401
+from .sp import (  # noqa: F401
+    ring_attention,
+    stripe_tokens,
+    striped_ring_attention,
+    ulysses_attention,
+    unstripe_tokens,
+)
 from .pp import pipeline_apply, pipeline_loss  # noqa: F401
 from .moe import moe_layer, top1_gating  # noqa: F401
 from .fsdp import fsdp_specs, opt_state_specs, fsdp_train_step  # noqa: F401

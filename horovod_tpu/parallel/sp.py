@@ -31,60 +31,26 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
-                   block_q: int = 512, block_k: int = 512):
-    """Causal ring attention over the 'sp' axis.
-
-    Sequence is block-sharded: chip i holds tokens [i*s_loc, (i+1)*s_loc).
-    Returns the attention output for the local Q block, same shape/dtype
-    as q ([batch, s_loc, heads, head_dim]).
-
-    ``use_flash=None`` auto-selects the Pallas kernel on TPU and the
-    differentiable XLA fallback elsewhere.
-    """
-    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
-
+def _ring_scan(q, k, v, axis_name, round_stats):
+    """Shared ring-attention scaffold: K/V rotate via ``lax.ppermute``
+    under one ``lax.scan`` while an online softmax combines each round's
+    normalized (o, m, l) block stats exactly. ``round_stats(qf, kf, vf,
+    r, i, j)`` produces the current round's stats (layout [b*h, s, ...]);
+    layout variants (block-sharded vs striped) differ only there."""
     n = lax.axis_size(axis_name)
     i = lax.axis_index(axis_name)
     b, s, h, d = q.shape
-    if use_flash is None:
-        # kernel blocks must tile the local sequence exactly; fall back to
-        # the XLA stats path for shapes that don't (no silent crash for
-        # non-power-of-two shards)
-        use_flash = (jax.default_backend() == "tpu"
-                     and s % min(block_q, s) == 0
-                     and s % min(block_k, s) == 0)
-    # kernel layout: [B=b*h, s, d]
-    def to_flat(x):
+
+    def to_flat(x):  # kernel layout: [B=b*h, s, d]
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qf = to_flat(q)
     perm = [(x, (x + 1) % n) for x in range(n)]
 
-    def stats(kf, vf, causal: bool):
-        if use_flash:
-            return attention_stats(qf, kf, vf, causal, block_q, block_k)
-        return _lax_stats(qf, kf, vf, causal)
-
     def round_fn(carry, r):
         kf, vf, m_acc, l_acc, o_acc = carry
-        j = (i - r) % n  # source block index of the K/V currently resident
-        # causal block cases: diagonal (r==0) → triangular; j<i → full;
-        # j>i → skip (entirely masked). Round 0 is the diagonal, so every
-        # row sees ≥1 real entry before any skip round — the online
-        # softmax stays finite.
-        branch = jnp.where(r == 0, 0, jnp.where(j < i, 1, 2))
-        o_r, m_r, l_r = lax.switch(branch, [
-            lambda kv: stats(kv[0], kv[1], True),
-            lambda kv: stats(kv[0], kv[1], False),
-            # pvary: constants are replication-typed; the other branches'
-            # outputs vary over the sp axis, and switch demands equal types
-            lambda kv: (jnp.zeros_like(qf),
-                        lax.pvary(jnp.full((b * h, s), NEG_INF, jnp.float32),
-                                  axis_name),
-                        lax.pvary(jnp.zeros((b * h, s), jnp.float32),
-                                  axis_name)),
-        ], (kf, vf))
+        j = (i - r) % n  # source shard of the resident K/V
+        o_r, m_r, l_r = round_stats(qf, kf, vf, r, i, j)
         m_new = jnp.maximum(m_acc, m_r)
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m_r - m_new)
@@ -103,6 +69,120 @@ def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
     (_, _, _, l_acc, o_acc), _ = lax.scan(round_fn, init, jnp.arange(n))
     out = o_acc / jnp.where(l_acc == 0.0, 1.0, l_acc)[..., None]
     return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3)).astype(q.dtype)
+
+
+def _auto_flash(s, block_q, block_k, use_flash):
+    if use_flash is not None:
+        return use_flash
+    # kernel blocks must tile the local sequence exactly; fall back to
+    # the XLA stats path for shapes that don't
+    return (jax.default_backend() == "tpu"
+            and s % min(block_q, s) == 0 and s % min(block_k, s) == 0)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
+                   block_q: int = 512, block_k: int = 512):
+    """Causal ring attention over the 'sp' axis.
+
+    Sequence is block-sharded: chip i holds tokens [i*s_loc, (i+1)*s_loc).
+    Returns the attention output for the local Q block, same shape/dtype
+    as q ([batch, s_loc, heads, head_dim]).
+
+    ``use_flash=None`` auto-selects the Pallas kernel on TPU and the
+    differentiable XLA fallback elsewhere.
+    """
+    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
+
+    use_flash = _auto_flash(q.shape[1], block_q, block_k, use_flash)
+    axis = axis_name
+
+    def stats(qf, kf, vf, causal):
+        if use_flash:
+            return attention_stats(qf, kf, vf, causal, block_q, block_k)
+        return _lax_stats(qf, kf, vf, causal)
+
+    def round_stats(qf, kf, vf, r, i, j):
+        # causal block cases: diagonal (r==0) → triangular; j<i → full;
+        # j>i → skip (entirely masked). Round 0 is the diagonal, so every
+        # row sees ≥1 real entry before any skip round — the online
+        # softmax stays finite.
+        B, sq = qf.shape[0], qf.shape[1]
+        branch = jnp.where(r == 0, 0, jnp.where(j < i, 1, 2))
+        return lax.switch(branch, [
+            lambda kv: stats(qf, kv[0], kv[1], True),
+            lambda kv: stats(qf, kv[0], kv[1], False),
+            # pvary: constants are replication-typed; the other branches'
+            # outputs vary over the sp axis, and switch demands equal types
+            lambda kv: (jnp.zeros_like(qf),
+                        lax.pvary(jnp.full((B, sq), NEG_INF, jnp.float32),
+                                  axis),
+                        lax.pvary(jnp.zeros((B, sq), jnp.float32), axis)),
+        ], (kf, vf))
+
+    return _ring_scan(q, k, v, axis_name, round_stats)
+
+
+def striped_ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
+                           block_q: int = 512, block_k: int = 512):
+    """Causal ring attention with STRIPED token layout — load-balanced.
+
+    Block-sharded causal ring attention wastes ~half the machine: in
+    round r only the chips with source index ≤ their own compute a real
+    block, yet every chip waits out the round (the wall-clock is
+    max-over-chips). Striping the sequence round-robin — chip i holds
+    global tokens i, i+n, i+2n, … (`stripe_tokens`) — makes every
+    (Q-shard, K-shard) pair a triangular block: for resident source
+    j = (i−r) mod n the causal condition k_global ≤ q_global reduces to
+    t_k ≤ t_q when j ≤ i and t_k < t_q when j > i (t = position within
+    the shard). Every chip computes equal work every round — ~2×
+    steady-state utilization for long causal sequences (Striped
+    Attention, arXiv:2311.09431; same primitive family the reference
+    exposes only as hvd.alltoall).
+
+    Inputs are striped per-chip blocks [batch, s_loc, heads, head_dim]
+    inside a shard_map over ``axis_name``; outputs stay striped (invert
+    with `unstripe_tokens` after gathering).
+    """
+    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
+
+    use_flash = _auto_flash(q.shape[1], block_q, block_k, use_flash)
+
+    def stats(qf, kf, vf, offset):
+        if use_flash:
+            return attention_stats(qf, kf, vf, True, block_q, block_k,
+                                   offset)
+        return _lax_stats(qf, kf, vf, True, offset)
+
+    def round_stats(qf, kf, vf, r, i, j):
+        # j <= i: inclusive diagonal; j > i: strict. Both are real
+        # triangular work — no skip branch, no idle chips.
+        return lax.switch(
+            jnp.where(j <= i, 0, 1),
+            [lambda kv: stats(qf, kv[0], kv[1], 0),
+             lambda kv: stats(qf, kv[0], kv[1], 1)],
+            (kf, vf))
+
+    return _ring_scan(q, k, v, axis_name, round_stats)
+
+
+def stripe_tokens(x, n: int, axis: int = 1):
+    """Reorder a GLOBAL sequence so block-sharding over ``n`` chips gives
+    the striped layout: chip i receives global tokens i, i+n, i+2n, …
+    Closed form: gather with arange(S).reshape(S//n, n).T.ravel()."""
+    S = x.shape[axis]
+    if S % n:
+        raise ValueError(f"sequence length {S} must divide by {n}")
+    idx = jnp.arange(S).reshape(S // n, n).T.reshape(-1)
+    return jnp.take(x, idx, axis=axis)
+
+
+def unstripe_tokens(x, n: int, axis: int = 1):
+    """Inverse of `stripe_tokens`: gather with the transposed reshape."""
+    S = x.shape[axis]
+    if S % n:
+        raise ValueError(f"sequence length {S} must divide by {n}")
+    idx = jnp.arange(S).reshape(n, S // n).T.reshape(-1)
+    return jnp.take(x, idx, axis=axis)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", attn_fn=None):
