@@ -217,6 +217,11 @@ def test_long_context_ring_attention_example():
                 os.path.join(EXAMPLES, "long_context_ring_attention.py"),
                 "--seq-len", "512", "--steps", "2", "--d-model", "128"])
     assert "tok/s" in out
+    out = _run([sys.executable,
+                os.path.join(EXAMPLES, "long_context_ring_attention.py"),
+                "--seq-len", "512", "--steps", "2", "--d-model", "128",
+                "--striped"])
+    assert "striped" in out and "tok/s" in out
 
 
 def test_scaling_harness_smoke():

@@ -85,3 +85,30 @@ def test_flash_bf16():
     assert o.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(o, np.float32),
                                np.asarray(ref, np.float32), atol=3e-2)
+
+
+def test_strict_causal_offset_kernel_matches_oracle(qkv):
+    """causal_offset=1 (strict: row > col) — the mask striped ring
+    attention's j>i rounds select on TPU. Kernel (interpret mode here,
+    compiled on a real chip) vs the XLA stats fallback vs the dense
+    oracle with the diagonal excluded. Row 0 is fully masked: the stats
+    contract there is m = NEG_INF (o and l are unconstrained garbage,
+    exactly annihilated in the ring combine by beta = exp(NEG_INF - m)
+    = 0 — asserted in test_parallel.py's striped equivalence)."""
+    from horovod_tpu.ops.pallas.flash_attention import NEG_INF
+
+    q, k, v = qkv
+    o_k, m_k, l_k = attention_stats(q, k, v, True, 128, 128, 1)
+    o_x, m_x, l_x = _lax_stats(q, k, v, True, 1)
+    np.testing.assert_allclose(np.asarray(o_k)[:, 1:], np.asarray(o_x)[:, 1:],
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_x), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(l_k)[:, 1:],
+                               np.asarray(l_x)[:, 1:], rtol=1e-5, atol=1e-5)
+    # empty first row: annihilation marker on both paths
+    assert np.all(np.asarray(m_k)[:, 0] == NEG_INF)
+    assert np.all(np.asarray(m_x)[:, 0] == NEG_INF)
+    # against the dense strict oracle
+    ref = _reference_attention(q, k, v, True, 1)
+    np.testing.assert_allclose(np.asarray(o_k)[:, 1:], np.asarray(ref)[:, 1:],
+                               atol=1e-4)
