@@ -48,6 +48,10 @@ class TransformerConfig:
     # FLOPs — the standard TPU trade when HBM, not MXU, is the binding
     # constraint (long sequences, big batches)
     remat: bool = False
+    # lm_loss streams the classifier over vocab chunks of this size
+    # (ops/xent.py) instead of materializing float32 logits [tokens,
+    # vocab] — the biggest tensor in long-context training. None = dense.
+    xent_chunk: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -116,8 +120,11 @@ def _constrain(x, spec, use_constraints):
 
 
 def apply(params, tokens, cfg: TransformerConfig, *, use_constraints: bool = True,
-          attn_fn=None, positions=None):
-    """Forward pass → logits (float32).
+          attn_fn=None, positions=None,
+          return_hidden: bool = False):
+    """Forward pass → logits (float32), or — with ``return_hidden=True``
+    — the pre-projection hidden states [b, s, d] in ``cfg.dtype`` for
+    the chunked LM loss (lm_loss with cfg.xent_chunk).
 
     ``attn_fn(q, k, v)`` hook (q/k/v: [b, s, h, hd]) lets
     `horovod_tpu.parallel.sp` substitute ring attention or Ulysses
@@ -155,6 +162,8 @@ def apply(params, tokens, cfg: TransformerConfig, *, use_constraints: bool = Tru
     for blk in params["blocks"]:
         x = block_fn(x, blk)
     x = _rmsnorm(x, params["ln_f"]["scale"])
+    if return_hidden:
+        return x  # pre-projection activations for the chunked LM loss
     logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), params["embed"])
     return logits
 
@@ -171,9 +180,20 @@ def causal_attention(q, k, v):
 
 
 def lm_loss(params, tokens, cfg: TransformerConfig, **kw):
-    """Next-token cross-entropy (mean over tokens)."""
-    logits = apply(params, tokens[:, :-1], cfg, **kw)
+    """Next-token cross-entropy (mean over tokens).
+
+    With ``cfg.xent_chunk`` set, the classifier streams over vocab
+    chunks (ops/xent.py chunked_softmax_xent) and float32 logits
+    [tokens, vocab] are never materialized."""
     targets = tokens[:, 1:]
+    if cfg.xent_chunk:
+        from ..ops.xent import chunked_softmax_xent
+
+        h = apply(params, tokens[:, :-1], cfg, return_hidden=True, **kw)
+        b, s, d = h.shape
+        return chunked_softmax_xent(h.reshape(b * s, d), params["embed"],
+                                    targets.reshape(-1), cfg.xent_chunk)
+    logits = apply(params, tokens[:, :-1], cfg, **kw)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)

@@ -1,0 +1,124 @@
+"""Chunked softmax cross-entropy — the LM loss without the logits tensor.
+
+The standard LM loss materializes float32 logits [tokens, vocab] — at
+seq 32k, vocab 128k that is 16 GiB, usually the single biggest tensor in
+long-context training (bigger than any activation once remat is on).
+This computes loss and gradients streaming over VOCAB CHUNKS with an
+online logsumexp, so peak memory is one [tokens, chunk] block:
+
+- forward: ``lax.scan`` over chunks of the projection matrix; carries
+  (running max, rescaled exp-sum, target logit) — the same online
+  softmax algebra as flash attention, applied to the classifier.
+- backward (custom VJP): a second scan recomputes each logits chunk,
+  forms ``dlogits = (softmax - onehot) * ct / N`` for that chunk only,
+  and accumulates ``dx`` while emitting per-chunk ``dW`` slices.
+
+Greenfield vs the reference (SURVEY.md §2.3: the reference is a
+communication library with no model-side kernels); the technique is the
+standard fused/chunked-CE pattern used by TPU LM codebases.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunks(V: int, chunk: int) -> int:
+    chunk = min(chunk, V)
+    if V % chunk:
+        raise ValueError(
+            f"vocab size {V} must be divisible by xent chunk {chunk}")
+    return V // chunk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(x, w, targets, chunk: int = 8192):
+    """Mean cross-entropy of ``softmax(x @ w.T)`` against ``targets``.
+
+    x: [N, d] activations; w: [V, d] classifier (embedding) matrix;
+    targets: [N] int ids. Returns the scalar mean loss. Differentiable
+    in x and w; logits are never materialized beyond [N, chunk].
+    """
+    loss, _ = _forward(x, w, targets, chunk)
+    return loss
+
+
+def _forward(x, w, targets, chunk: int):
+    N, d = x.shape
+    V = w.shape[0]
+    # mirror the dense path exactly: JAX take_along_axis clamps
+    # out-of-range ids, so e.g. -1 padding hits index 0 there — without
+    # this the online path would leave tgt at NEG_INF (loss ~1e30) and
+    # drop the onehot from the gradient, silently changing training
+    targets = jnp.clip(targets, 0, V - 1)
+    n_chunks = _chunks(V, chunk)
+    xf = x.astype(jnp.float32)
+    wc = w.reshape(n_chunks, V // n_chunks, d)
+
+    def body(carry, wi_c):
+        m, l, tgt = carry
+        wi, c = wi_c
+        logits = (xf @ wi.astype(jnp.float32).T)          # [N, C]
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        base = c * logits.shape[1]
+        local = targets - base
+        in_chunk = (local >= 0) & (local < logits.shape[1])
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, logits.shape[1] - 1)[:, None],
+            axis=1)[:, 0]
+        tgt = jnp.where(in_chunk, picked, tgt)
+        return (m_new, l, tgt), None
+
+    init = (jnp.full((N,), NEG_INF, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.full((N,), NEG_INF, jnp.float32))
+    (m, l, tgt), _ = lax.scan(body, init, (wc, jnp.arange(n_chunks)))
+    lse = m + jnp.log(l)
+    loss = jnp.mean(lse - tgt)
+    return loss, (lse,)
+
+
+def _fwd(x, w, targets, chunk):
+    loss, (lse,) = _forward(x, w, targets, chunk)
+    return loss, (x, w, targets, lse)
+
+
+def _bwd(chunk, res, ct):
+    x, w, targets, lse = res
+    N, d = x.shape
+    V = w.shape[0]
+    targets = jnp.clip(targets, 0, V - 1)
+    n_chunks = _chunks(V, chunk)
+    xf = x.astype(jnp.float32)
+    wc = w.reshape(n_chunks, V // n_chunks, d)
+    scale = ct / N  # d(mean)/d(per-token) — ct is the loss cotangent
+
+    def body(dx, wi_c):
+        wi, c = wi_c
+        wif = wi.astype(jnp.float32)
+        logits = xf @ wif.T                                # [N, C]
+        p = jnp.exp(logits - lse[:, None])                 # softmax chunk
+        base = c * logits.shape[1]
+        local = targets - base
+        in_chunk = (local >= 0) & (local < logits.shape[1])
+        onehot = (jnp.where(in_chunk, local, -1)[:, None]
+                  == jnp.arange(logits.shape[1])[None, :])
+        dlogits = (p - onehot.astype(jnp.float32)) * scale
+        dx = dx + dlogits @ wif                            # [N, d]
+        dwi = dlogits.T @ xf                               # [C, d]
+        return dx, dwi
+
+    dx, dwc = lax.scan(body, jnp.zeros((N, d), jnp.float32),
+                       (wc, jnp.arange(n_chunks)))
+    return (dx.astype(x.dtype), dwc.reshape(V, d).astype(w.dtype), None)
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)
