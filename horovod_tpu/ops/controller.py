@@ -109,6 +109,20 @@ def entry_signature(entry) -> list:
     sig = [entry.op, dtype, shape, int(entry.reduce_op),
            entry.root_rank, float(entry.prescale_factor),
            float(entry.postscale_factor), ps_name, str(dev)]
+    if ps is not None and ps_name != "global" \
+            and getattr(ps, "_proc_indices", None) is not None:
+        # readiness is scoped to the set's member processes (reference:
+        # each ProcessSet owns its own controller/message table) — carry
+        # their GLOBAL cross-ranks so the coordinator knows who must
+        # submit. Deliberately carried IN the signature rather than
+        # resolved from the coordinator's registry: a worker may create
+        # the set and submit before rank 0's add_process_set runs, and
+        # SAME_AS_LAST makes the per-round byte cost a one-time hit
+        from ..common import context as ctx_mod
+
+        gprocs = ctx_mod.global_process_set()._proc_indices
+        sig.append(sorted(gprocs.index(p)
+                          for p in set(ps._proc_indices)))
     entry._sig = sig
     return sig
 
@@ -373,7 +387,8 @@ class _Coordinator(threading.Thread):
                 # reference JoinOp semantics). At least one real submission
                 # is required — join alone must not fire ghost collectives.
                 ready = [n for n in self.order
-                         if len(self.table[n][1] | self._joined) == self.size]
+                         if not (self._required(n)
+                                 - self.table[n][1] - self._joined)]
                 join_done = None
                 if len(self._joined) == self.size:
                     join_done = self._last_joined_rank
@@ -430,6 +445,15 @@ class _Coordinator(threading.Thread):
         except Exception:
             pass  # store unreachable: workers fall back to their timeout
 
+    def _required(self, name: str) -> set:
+        """Cross-ranks that must submit ``name``: the process set's
+        members when the signature carries them (sub-sets), else the
+        world (reference: per-ProcessSet message tables)."""
+        sig = self.table[name][0]
+        if len(sig) > 9 and sig[9]:
+            return set(sig[9])
+        return set(range(self.size))
+
     def _check_stalled_tensors(self):
         """Per-tensor stall attribution after a completed round: a tensor
         submitted by some ranks but not others for longer than
@@ -441,10 +465,11 @@ class _Coordinator(threading.Thread):
 
         now = _time.monotonic()
         for n, (_, ranks) in list(self.table.items()):
-            if len(ranks | self._joined) == self.size or n in self.errors:
+            required = self._required(n)
+            if not (required - ranks - self._joined) or n in self.errors:
                 continue
             age = now - self._first_seen.get(n, now)
-            missing = sorted(set(range(self.size)) - ranks - self._joined)
+            missing = sorted(required - ranks - self._joined)
             if (self.stall_shutdown_s > 0 and age > self.stall_shutdown_s):
                 self.errors[n] = (
                     f"tensor {n!r} stalled for {age:.0f} s waiting on ranks "

@@ -376,7 +376,7 @@ class BackgroundRuntime:
         from .controller import entry_signature
 
         for e in batch:
-            self._pending[e.name] = e
+            self._pending[self._wire_name(e)] = e
         sigs = {n: entry_signature(e) for n, e in self._pending.items()}
         try:
             resp = self.controller.negotiate(sigs, joined=self.joined)
@@ -406,14 +406,31 @@ class BackgroundRuntime:
                 # fabricate a zero contribution from the coordinator's
                 # signature (reference: joined ranks contribute zeros,
                 # global_state.h:107-111). handle=-1: no caller is waiting.
+                # Never for a sub-process-set this rank is not in.
                 sig = resp["sigs"].get(n)
-                if sig is not None:
+                if sig is not None and self._member_of_sig(sig):
                     out.append(self._zero_entry_from_sig(n, sig))
         if resp.get("join_done") is not None:
             self._join_last_rank = int(resp["join_done"])
             self.joined = False
             self._join_done_evt.set()
         return out
+
+    def _member_of_sig(self, sig: list) -> bool:
+        if len(sig) <= 9 or not sig[9]:
+            return True  # global set: everyone is a member
+        return self.process_set.cross_rank in set(sig[9])
+
+    @staticmethod
+    def _wire_name(e: TensorEntry) -> str:
+        """Negotiation key: plain name for the global set, scoped by the
+        process-set name otherwise — tensors on DIFFERENT sets may share
+        a user name legitimately (reference keeps one message table per
+        ProcessSet) and must not collide into a signature mismatch."""
+        ps = e.process_set
+        pname = getattr(ps, "name", None)
+        return e.name if not pname or pname == "global" \
+            else f"ps:{pname}:{e.name}"
 
     @staticmethod
     def _zero_entry_from_sig(name: str, sig: list) -> TensorEntry:
@@ -424,10 +441,21 @@ class BackgroundRuntime:
         op, dtype, shape = sig[0], sig[1], list(sig[2])
         if op in ("allgather", "alltoall") and shape:
             shape[0] = 0  # ragged ops: the sig's first dim is the "*" mark
+        ps = None
+        plain = name
+        if sig[7] and sig[7] != "global":
+            from ..common import context as ctx_mod
+
+            ps = ctx_mod.context().process_sets.get(sig[7])
+            # decode by the SIGNATURE, not a name prefix: a global tensor
+            # whose user name merely starts with "ps:" must stay verbatim
+            scope = f"ps:{sig[7]}:"
+            if name.startswith(scope):
+                plain = name[len(scope):]
         return TensorEntry(
-            name=name, op=op, tensor=np.zeros(shape, dtype=np.dtype(dtype)),
+            name=plain, op=op, tensor=np.zeros(shape, dtype=np.dtype(dtype)),
             reduce_op=C.ReduceOp(sig[3]), root_rank=sig[4],
-            prescale_factor=sig[5], postscale_factor=sig[6])
+            prescale_factor=sig[5], postscale_factor=sig[6], process_set=ps)
 
     def join(self, timeout: Optional[float] = None) -> int:
         """Reference hvd.join(): mark this rank out of data, keep

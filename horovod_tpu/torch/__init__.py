@@ -411,7 +411,8 @@ def reducescatter(tensor, name=None, op=None, process_set=None):
 
 
 def sparse_allreduce_async(tensor, name, op=Average,
-                           prescale_factor=1.0, postscale_factor=1.0):
+                           prescale_factor=1.0, postscale_factor=1.0,
+                           process_set=None):
     """Sparse COO reduction via allgather of values+indices (reference
     torch/mpi_ops.py:512). Returns a thunk that completes the op.
     ``prescale_factor``/``postscale_factor`` scale the values around the
@@ -422,8 +423,9 @@ def sparse_allreduce_async(tensor, name, op=Average,
     values = t.values()
     if prescale_factor != 1.0:
         values = values * prescale_factor
-    hi = allgather_async(t.indices().t().contiguous(), f"{name}.indices")
-    hv = allgather_async(values, f"{name}.values")
+    hi = allgather_async(t.indices().t().contiguous(), f"{name}.indices",
+                         process_set=process_set)
+    hv = allgather_async(values, f"{name}.values", process_set=process_set)
 
     def finish():
         indices = synchronize(hi).t()
@@ -433,7 +435,9 @@ def sparse_allreduce_async(tensor, name, op=Average,
         if op == Average:
             # eager collectives contribute per *process* (cross_size), not
             # per chip — divide by the actual number of contributors
-            values = values / cross_size()
+            n = (process_set.cross_size if process_set is not None
+                 else cross_size())
+            values = values / n
         return torch.sparse_coo_tensor(indices, values, t.shape).coalesce()
 
     return finish
@@ -489,7 +493,8 @@ class _DistributedMixin:
     def _hvd_setup(self, named_parameters, compression, op,
                    backward_passes_per_step, prescale_factor,
                    postscale_factor, gradient_predivide_factor=1.0,
-                   sparse_as_dense=False):
+                   sparse_as_dense=False, process_set=None):
+        self._process_set = process_set
         if gradient_predivide_factor != 1.0:
             if op != Average:
                 # reference optimizer.py:76: predivide splits an Average
@@ -500,8 +505,10 @@ class _DistributedMixin:
             # user pick where the division happens for numerics
             op = Sum
             prescale_factor = prescale_factor / gradient_predivide_factor
+            n = (process_set.cross_size if process_set is not None
+                 else max(cross_size(), 1))
             postscale_factor = (postscale_factor * gradient_predivide_factor
-                                / max(cross_size(), 1))
+                                / max(n, 1))
         self._compression = compression
         self._op = op
         self._bpps = backward_passes_per_step
@@ -544,12 +551,14 @@ class _DistributedMixin:
                 self._sparse_thunks[p] = sparse_allreduce_async(
                     grad, name=self._names[p], op=self._op,
                     prescale_factor=self._prescale,
-                    postscale_factor=self._postscale)
+                    postscale_factor=self._postscale,
+                    process_set=self._process_set)
                 return
         comp, ctx = self._compression.compress(grad)
         h = allreduce_async(comp, name=self._names[p], op=self._op,
                             prescale_factor=self._prescale,
-                            postscale_factor=self._postscale)
+                            postscale_factor=self._postscale,
+                            process_set=self._process_set)
         self._handles[p] = (h, ctx)
 
     def synchronize(self):
@@ -737,7 +746,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
                          gradient_predivide_factor: float = 1.0,
-                         sparse_as_dense: bool = False):
+                         sparse_as_dense: bool = False,
+                         process_set=None):
     if hasattr(optimizer, "_hvd_base"):
         # Re-wrapping would make the grafted step() re-enter itself through
         # the newest swapped class (infinite recursion) and register every
@@ -770,7 +780,7 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
         list(named_parameters) if named_parameters is not None else None,
         compression, op, backward_passes_per_step,
         prescale_factor, postscale_factor, gradient_predivide_factor,
-        sparse_as_dense)
+        sparse_as_dense, process_set)
     return optimizer
 
 

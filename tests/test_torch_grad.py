@@ -327,3 +327,52 @@ def test_adasum_delta_optimizer_two_processes(tmp_path):
     script.write_text(ADASUM_OPT_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+PROCESS_SET_OPT_WORKER = textwrap.dedent("""
+    import os
+    # ONE chip per process: chip index i == process i, so the singleton
+    # chip sets below are singleton PROCESS sets
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu as core
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    # two singleton process sets: each rank reduces only with itself
+    ps0 = core.add_process_set([0], name="opt.ps0")
+    ps1 = core.add_process_set([1], name="opt.ps1")
+    mine = ps0 if r == 0 else ps1
+
+    w = torch.nn.Parameter(torch.zeros(2))
+    opt = torch.optim.SGD([w], lr=1.0)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=[("w.ps", w)],
+                                   process_set=mine)
+    (w * float(r + 1)).sum().backward()
+    opt.step()
+    # no cross-rank mixing: each rank keeps its own gradient (r+1)
+    np.testing.assert_allclose(w.detach().numpy(), [-(r + 1.0)] * 2,
+                               rtol=1e-6)
+
+    # default (global) optimizer on the same model averages: (1+2)/2
+    w2 = torch.nn.Parameter(torch.zeros(2))
+    opt2 = torch.optim.SGD([w2], lr=1.0)
+    opt2 = hvd.DistributedOptimizer(opt2, named_parameters=[("w.glob", w2)])
+    (w2 * float(r + 1)).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(w2.detach().numpy(), [-1.5] * 2, rtol=1e-6)
+    print(f"PS-OPT-OK rank {r}")
+""")
+
+
+def test_distributed_optimizer_process_set(tmp_path):
+    """Reference optimizer process_set support: gradient reduction scoped
+    to the given process set, not the world."""
+    script = tmp_path / "ps_opt_worker.py"
+    script.write_text(PROCESS_SET_OPT_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc == 0
