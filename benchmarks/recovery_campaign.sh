@@ -35,7 +35,7 @@ phase() {  # phase <name> <timeout_s> <cmd...>
 }
 
 all_done() {
-  for m in resnet probe transformer sweep bench; do
+  for m in resnet probe transformer sweep bench memory; do
     [ -f "benchmarks/markers/$m.done" ] || return 1
   done
   return 0
@@ -49,19 +49,20 @@ d = jax.devices()[0]
 assert 'tpu' in (d.platform + ' ' + d.device_kind).lower(), d
 float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     echo "TUNNEL-UP $(date +%H:%M:%S)" | tee -a "$LOG"
-    # value order (headline first); the four python phases carry their
-    # own stall watchdog (no-progress abort, rc=42), so a mid-run wedge
-    # costs minutes, not the phase timeout. The final bench phase (no
-    # watchdog — bench.py's parent wrapper manages its own child
-    # timeouts, worst case ~80 min) records a real-chip headline JSON
-    # and pre-warms the driver's bench run; the artifact is committed
+    # value order (headline first); every python phase except bench
+    # carries its own stall watchdog (no-progress abort, rc=42), so a
+    # mid-run wedge costs minutes, not the phase timeout. The bench
+    # phase has no watchdog — bench.py's parent wrapper manages its own
+    # child timeouts (worst case ~80 min) — and commits its artifact
     # via tmp+mv only after validation, so a fallback/truncated run
-    # never leaves a bad bench_r3_chip.json behind.
+    # never leaves a bad bench_r3_chip.json behind. The memory phase
+    # records HBM CompiledMemoryStats evidence last.
     phase resnet     2700  python benchmarks/resnet_phase.py     && \
     phase probe       900  python benchmarks/probe_conv.py       && \
     phase transformer 2700 python benchmarks/bench_transformer.py && \
     phase sweep      3600  python benchmarks/mfu_campaign.py     && \
-    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r3_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r3_chip.tmp && ! grep -q fallback benchmarks/.bench_r3_chip.tmp && mv benchmarks/.bench_r3_chip.tmp benchmarks/bench_r3_chip.json'
+    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r3_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r3_chip.tmp && ! grep -q fallback benchmarks/.bench_r3_chip.tmp && mv benchmarks/.bench_r3_chip.tmp benchmarks/bench_r3_chip.json' && \
+    phase memory     1800  python benchmarks/memory_analysis.py --big
   else
     echo "probe down $(date +%H:%M:%S)" >> "$LOG"
   fi

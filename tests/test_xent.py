@@ -96,3 +96,35 @@ def test_lm_loss_chunked_matches_dense_with_grads():
     for a, b in zip(flat_c, flat_d):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=1e-5)
+
+
+def test_chunked_xent_reduces_compiled_temp_memory():
+    """The memory claim, measured: the chunked train step's compiled temp
+    (activation/scratch) memory is materially below the dense one —
+    the [tokens, vocab] float32 logits and their cotangent are gone from
+    the executable (structural, backend-independent)."""
+    import optax
+
+    base = dict(vocab_size=4096, d_model=128, n_heads=4, n_layers=2,
+                d_ff=256, max_seq=256, dtype=jnp.bfloat16,
+                dp_axis=None, tp_axis=None, sp_axis=None)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 4096, (2, 256)))
+    params = T.init(jax.random.PRNGKey(0), T.TransformerConfig(**base))
+    opt = optax.sgd(1e-2)
+    state = opt.init(params)
+
+    def temp_mb(cfg):
+        def step(params, state, tokens):
+            loss, g = jax.value_and_grad(
+                lambda p: T.lm_loss(p, tokens, cfg,
+                                    use_constraints=False))(params)
+            u, state2 = opt.update(g, state, params)
+            return optax.apply_updates(params, u), state2, loss
+
+        c = jax.jit(step).lower(params, state, tokens).compile()
+        return c.memory_analysis().temp_size_in_bytes / 2**20
+
+    dense = temp_mb(T.TransformerConfig(**base))
+    chunked = temp_mb(T.TransformerConfig(**base, xent_chunk=256))
+    assert chunked < dense * 0.8, (dense, chunked)
