@@ -23,8 +23,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _common import (REPO, make_recorder,  # noqa: E402
-                     start_stall_watchdog)
+from _common import (make_recorder,  # noqa: E402
+                     require_tpu, start_stall_watchdog)
 
 record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "memory_analysis.jsonl"))
@@ -65,6 +65,11 @@ def main():
     args = ap.parse_args()
 
     start_stall_watchdog(1200)  # must cover one --big remote compile
+    if args.big:
+        # --big is the campaign's HBM-evidence phase: a CPU-fallback run
+        # would succeed (compile-only) and permanently mark the phase
+        # done with meaningless remat rows
+        require_tpu()
 
     import jax
     import jax.numpy as jnp

@@ -21,7 +21,8 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, inputs, *,
-                   axis_name: str = "pp", n_micro: int | None = None):
+                   axis_name: str = "pp", n_micro: int | None = None,
+                   remat_stage: bool = False):
     """Run a pipelined forward pass.
 
     Args:
@@ -33,6 +34,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, inputs, *,
       inputs: [n_micro, mb, ...] microbatched inputs (replicated; only
         stage 0 reads them).
       n_micro: number of microbatches (defaults to inputs.shape[0]).
+      remat_stage: rematerialize the stage in the backward pass — the
+        scan-over-ticks then stores only each tick's stage INPUT
+        (one microbatch activation) instead of every intermediate
+        inside ``stage_fn``; with deep stages this is the difference
+        between O(ticks x stage_depth) and O(ticks) activation memory,
+        the standard TPU pipeline configuration (GPipe + remat).
 
     Returns: [n_micro, mb, ...] outputs (valid on the last stage; other
       stages return zeros — close with a psum/select or read on stage
@@ -44,6 +51,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, inputs, *,
         n_micro = inputs.shape[0]
     total = n_micro + n - 1
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
 
     mb_shape = inputs.shape[1:]
     y0 = jax.eval_shape(stage_fn, stage_params, jnp.zeros(mb_shape, inputs.dtype))
@@ -78,14 +87,16 @@ def pipeline_apply(stage_fn: Callable, stage_params, inputs, *,
 
 
 def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params, inputs,
-                  targets, *, axis_name: str = "pp", n_micro: int | None = None):
+                  targets, *, axis_name: str = "pp", n_micro: int | None = None,
+                  remat_stage: bool = False):
     """Pipelined loss: forward through stages, loss on the last stage,
     psum'd so every stage sees the same scalar (and the backward pipeline
     flows back through the ppermutes under jax.grad)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     outputs = pipeline_apply(stage_fn, stage_params, inputs,
-                             axis_name=axis_name, n_micro=n_micro)
+                             axis_name=axis_name, n_micro=n_micro,
+                             remat_stage=remat_stage)
     per_micro = loss_fn(outputs, targets)
     local = jnp.where(idx == n - 1, per_micro, jnp.zeros_like(per_micro))
     return lax.psum(local, axis_name)
