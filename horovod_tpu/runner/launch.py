@@ -98,22 +98,36 @@ def build_ssh_command(hostname: str, command: list[str], env: dict, *,
     return ssh_args + [hostname, remote]
 
 
-def _stream(prefix: str, pipe, out):
-    for line in iter(pipe.readline, b""):
-        out.write(f"[{prefix}]<stdout>: ".encode() if out is sys.stdout.buffer
-                  else f"[{prefix}]<stderr>: ".encode())
-        out.write(line)
-        out.flush()
+def _stream(prefix: str, pipe, out, tee_path: Optional[str] = None):
+    tee = open(tee_path, "wb") if tee_path else None
+    try:
+        for line in iter(pipe.readline, b""):
+            out.write(f"[{prefix}]<stdout>: ".encode()
+                      if out is sys.stdout.buffer
+                      else f"[{prefix}]<stderr>: ".encode())
+            out.write(line)
+            out.flush()
+            if tee is not None:
+                tee.write(line)
+                tee.flush()
+    finally:
+        if tee is not None:
+            tee.close()
 
 
 def launch_slots(command: list[str], slots: list[SlotInfo], *,
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
                  extra_env: Optional[dict] = None,
-                 verbose: bool = False) -> int:
+                 verbose: bool = False,
+                 output_filename: Optional[str] = None) -> int:
     """Spawn one worker per slot (local exec or SSH for remote hosts),
     stream rank-prefixed output, kill the job on first failure
-    (reference gloo_run.py:252-271)."""
+    (reference gloo_run.py:252-271). ``output_filename`` additionally
+    tees each rank into <dir>/rank.<r>.{out,err} (reference horovodrun
+    --output-filename)."""
+    if output_filename:
+        os.makedirs(output_filename, exist_ok=True)
     rendezvous = RendezvousServer()
     rendezvous.start()
     this_host = socket.gethostname()
@@ -137,10 +151,14 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
                                       ssh_identity_file=ssh_identity_file),
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE)
             procs.append(p)
-            for pipe, out in ((p.stdout, sys.stdout.buffer),
-                              (p.stderr, sys.stderr.buffer)):
-                t = threading.Thread(target=_stream, args=(str(slot.rank), pipe, out),
-                                     daemon=True)
+            for pipe, out, kind in ((p.stdout, sys.stdout.buffer, "out"),
+                                    (p.stderr, sys.stderr.buffer, "err")):
+                tee = (os.path.join(output_filename,
+                                    f"rank.{slot.rank}.{kind}")
+                       if output_filename else None)
+                t = threading.Thread(
+                    target=_stream, args=(str(slot.rank), pipe, out, tee),
+                    daemon=True)
                 t.start()
                 threads.append(t)
 
@@ -197,6 +215,22 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--hierarchical-allgather", action="store_true")
+    p.add_argument("--output-filename", default=None,
+                   help="directory for per-rank output files "
+                        "rank.<r>.{out,err} (reference horovodrun "
+                        "--output-filename); console streaming continues")
     p.add_argument("--log-level", default=None)
     # elastic
     p.add_argument("--min-np", type=int, default=None)
@@ -278,6 +312,29 @@ def _knob_env(args) -> dict:
         e[env_schema.HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
     if args.log_level:
         e[env_schema.HOROVOD_LOG_LEVEL] = args.log_level
+    if args.autotune_warmup_samples is not None:
+        e[env_schema.HOROVOD_AUTOTUNE_WARMUP_SAMPLES] = \
+            str(args.autotune_warmup_samples)
+    if args.autotune_steps_per_sample is not None:
+        e[env_schema.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE] = \
+            str(args.autotune_steps_per_sample)
+    if args.autotune_bayes_opt_max_samples is not None:
+        e[env_schema.HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES] = \
+            str(args.autotune_bayes_opt_max_samples)
+    if args.cache_capacity is not None:
+        e[env_schema.HOROVOD_CACHE_CAPACITY] = str(args.cache_capacity)
+    if args.no_stall_check:
+        e[env_schema.HOROVOD_STALL_CHECK_DISABLE] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        e[env_schema.HOROVOD_STALL_CHECK_TIME_SECONDS] = \
+            str(args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        e[env_schema.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS] = \
+            str(args.stall_check_shutdown_time_seconds)
+    if args.hierarchical_allreduce:
+        e[env_schema.HOROVOD_HIERARCHICAL_ALLREDUCE] = "1"
+    if args.hierarchical_allgather:
+        e[env_schema.HOROVOD_HIERARCHICAL_ALLGATHER] = "1"
     for kv in args.env:
         k, _, v = kv.partition("=")
         e[k] = v
@@ -298,6 +355,10 @@ def run_commandline(argv=None) -> int:
         return 2
 
     if args.host_discovery_script or args.min_np or args.max_np:
+        if args.output_filename:
+            print("hvdrun: --output-filename is not yet supported in "
+                  "elastic mode; per-rank files will not be written",
+                  file=sys.stderr)
         from ..elastic.driver import run_elastic
 
         return run_elastic(command, args)
@@ -315,7 +376,8 @@ def run_commandline(argv=None) -> int:
         return 2
     return launch_slots(command, slots, ssh_port=args.ssh_port,
                         ssh_identity_file=args.ssh_identity_file,
-                        extra_env=_knob_env(args), verbose=args.verbose)
+                        extra_env=_knob_env(args), verbose=args.verbose,
+                        output_filename=args.output_filename)
 
 
 def main():

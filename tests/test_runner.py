@@ -78,9 +78,24 @@ def test_cli_parser_surface():
     args = make_parser().parse_args(
         ["-np", "4", "-H", "a:2,b:2", "--cycle-time-ms", "2.5",
          "--timeline-filename", "/tmp/t.json", "--env", "FOO=bar",
+         "--cache-capacity", "512", "--no-stall-check",
+         "--stall-check-warning-time-seconds", "30",
+         "--hierarchical-allreduce", "--autotune-warmup-samples", "2",
+         "--output-filename", "/tmp/outdir",
          "python", "train.py"])
     assert args.num_proc == 4 and args.hosts == "a:2,b:2"
     assert args.command == ["python", "train.py"]
+    # reference horovodrun knobs map onto the one env schema
+    from horovod_tpu.runner.launch import _knob_env
+    from horovod_tpu.common import env as env_schema
+
+    e = _knob_env(args)
+    assert e[env_schema.HOROVOD_CACHE_CAPACITY] == "512"
+    assert e[env_schema.HOROVOD_STALL_CHECK_DISABLE] == "1"
+    assert e[env_schema.HOROVOD_STALL_CHECK_TIME_SECONDS] == "30.0"
+    assert e[env_schema.HOROVOD_HIERARCHICAL_ALLREDUCE] == "1"
+    assert e[env_schema.HOROVOD_AUTOTUNE_WARMUP_SAMPLES] == "2"
+    assert args.output_filename == "/tmp/outdir"
 
 
 WORKER = textwrap.dedent("""
@@ -159,3 +174,26 @@ def test_launch_local_rank_semantics(tmp_path):
     """))
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+def test_output_filename_per_rank_files(tmp_path):
+    """Reference horovodrun --output-filename: each rank's stdout/stderr
+    tees into <dir>/rank.<r>.{out,err} while console streaming stays."""
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('hello-from', os.environ['HOROVOD_RANK'])\n"
+        "print('oops', file=sys.stderr)\n")
+    outdir = tmp_path / "logs"
+    rc = run_commandline(["-np", "2", "--output-filename", str(outdir),
+                          sys.executable, str(script)])
+    assert rc == 0
+    for r in (0, 1):
+        out = (outdir / f"rank.{r}.out").read_text()
+        assert f"hello-from {r}" in out, out
+        assert "oops" in (outdir / f"rank.{r}.err").read_text()
+    # re-run truncates (reference horovodrun writes fresh files per run)
+    rc = run_commandline(["-np", "2", "--output-filename", str(outdir),
+                          sys.executable, str(script)])
+    assert rc == 0
+    assert (outdir / "rank.0.out").read_text().count("hello-from") == 1
