@@ -51,11 +51,20 @@ class WorkerHandle:
 
 
 class _SubprocessWorker(WorkerHandle):
-    def __init__(self, popen: subprocess.Popen):
+    def __init__(self, popen: subprocess.Popen, stream_threads=()):
         self.popen = popen
+        self._streams = list(stream_threads)
 
     def poll(self):
-        return self.popen.poll()
+        rc = self.popen.poll()
+        if rc is not None and self._streams:
+            # drain the output streams before the driver acts on the
+            # exit: the tee files must hold the rank's full output, and
+            # a respawned incarnation must not interleave with this one
+            for t in self._streams:
+                t.join(timeout=10)
+            self._streams = []
+        return rc
 
     def terminate(self):
         try:
@@ -230,7 +239,8 @@ def run_elastic(command: list[str], args) -> int:
     import tempfile
     import uuid
 
-    from ..runner.launch import _knob_env, build_ssh_command
+    from ..runner.launch import (_knob_env, build_ssh_command,
+                                 start_output_threads)
 
     if not args.host_discovery_script:
         raise SystemExit("elastic mode requires --host-discovery-script")
@@ -250,17 +260,36 @@ def run_elastic(command: list[str], args) -> int:
 
     base_env = make_base_env_fn(driver, extra)
 
+    out_dir = getattr(args, "output_filename", None)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    teed_ranks: set[int] = set()
+
     def create_worker(slot: SlotInfo, env: dict) -> WorkerHandle:
-        if slot.hostname in (socket.gethostname(), "localhost", "127.0.0.1"):
-            p = subprocess.Popen(command, env=env, stdout=sys.stdout,
-                                 stderr=sys.stderr)
+        local = slot.hostname in (socket.gethostname(), "localhost",
+                                  "127.0.0.1")
+        if local:
+            cmd = command
         else:
-            p = subprocess.Popen(
-                build_ssh_command(slot.hostname, command, env,
-                                  ssh_port=getattr(args, "ssh_port", None),
-                                  ssh_identity_file=getattr(
-                                      args, "ssh_identity_file", None)))
-        return _SubprocessWorker(p)
+            cmd = build_ssh_command(
+                slot.hostname, command, env,
+                ssh_port=getattr(args, "ssh_port", None),
+                ssh_identity_file=getattr(args, "ssh_identity_file", None))
+        if not out_dir:
+            p = subprocess.Popen(cmd, env=env if local else None,
+                                 stdout=sys.stdout, stderr=sys.stderr)
+            return _SubprocessWorker(p)
+        # per-rank tee: fresh files on the rank's FIRST incarnation,
+        # append across elastic respawns so one file tells the whole
+        # story of that rank (reference horovodrun --output-filename)
+        p = subprocess.Popen(cmd, env=env if local else None,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+        first = slot.rank not in teed_ranks
+        teed_ranks.add(slot.rank)
+        threads = start_output_threads(p, slot.rank, out_dir,
+                                       first_incarnation=first)
+        return _SubprocessWorker(p, threads)
 
     try:
         return driver.run(create_worker, base_env)

@@ -98,8 +98,9 @@ def build_ssh_command(hostname: str, command: list[str], env: dict, *,
     return ssh_args + [hostname, remote]
 
 
-def _stream(prefix: str, pipe, out, tee_path: Optional[str] = None):
-    tee = open(tee_path, "wb") if tee_path else None
+def _stream(prefix: str, pipe, out, tee_path: Optional[str] = None,
+            tee_mode: str = "wb"):
+    tee = open(tee_path, tee_mode) if tee_path else None
     try:
         for line in iter(pipe.readline, b""):
             out.write(f"[{prefix}]<stdout>: ".encode()
@@ -113,6 +114,28 @@ def _stream(prefix: str, pipe, out, tee_path: Optional[str] = None):
     finally:
         if tee is not None:
             tee.close()
+
+
+def start_output_threads(p, rank: int, output_filename: Optional[str],
+                         first_incarnation: bool = True) -> list:
+    """Start the rank-prefixed console streams for one worker, teeing
+    into <output_filename>/rank.<rank>.{out,err} when set (fresh file on
+    the first incarnation, append on elastic respawns). Returns the
+    stream threads — join them after the worker exits so the file holds
+    the full output."""
+    threads = []
+    for pipe, out, kind in ((p.stdout, sys.stdout.buffer, "out"),
+                            (p.stderr, sys.stderr.buffer, "err")):
+        tee = (os.path.join(output_filename, f"rank.{rank}.{kind}")
+               if output_filename else None)
+        t = threading.Thread(
+            target=_stream,
+            args=(str(rank), pipe, out, tee,
+                  "wb" if first_incarnation else "ab"),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    return threads
 
 
 def launch_slots(command: list[str], slots: list[SlotInfo], *,
@@ -151,16 +174,8 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
                                       ssh_identity_file=ssh_identity_file),
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE)
             procs.append(p)
-            for pipe, out, kind in ((p.stdout, sys.stdout.buffer, "out"),
-                                    (p.stderr, sys.stderr.buffer, "err")):
-                tee = (os.path.join(output_filename,
-                                    f"rank.{slot.rank}.{kind}")
-                       if output_filename else None)
-                t = threading.Thread(
-                    target=_stream, args=(str(slot.rank), pipe, out, tee),
-                    daemon=True)
-                t.start()
-                threads.append(t)
+            threads.extend(start_output_threads(p, slot.rank,
+                                                output_filename))
 
         exit_code = 0
         alive = set(range(len(procs)))
@@ -355,10 +370,6 @@ def run_commandline(argv=None) -> int:
         return 2
 
     if args.host_discovery_script or args.min_np or args.max_np:
-        if args.output_filename:
-            print("hvdrun: --output-filename is not yet supported in "
-                  "elastic mode; per-rank files will not be written",
-                  file=sys.stderr)
         from ..elastic.driver import run_elastic
 
         return run_elastic(command, args)

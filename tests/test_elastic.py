@@ -350,10 +350,12 @@ def test_elastic_crash_restart_end_to_end(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
+    logdir = tmp_path / "logs"
     p = subprocess.run(
         [_sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          "--min-np", "2", "--max-np", "2",
          "--host-discovery-script", str(disc),
+         "--output-filename", str(logdir),
          _sys.executable, str(worker)],
         env=env, capture_output=True, text=True, timeout=300)
     out = p.stdout + p.stderr
@@ -370,6 +372,10 @@ def test_elastic_crash_restart_end_to_end(tmp_path):
     assert all(s == "6" for _, s, _ in done), done
     # recovery really happened: the finishing incarnation is not the first
     assert all(i != "0" for _, _, i in done), done
+    # per-rank tee files exist and carry BOTH incarnations of rank 0
+    # (fresh file on first spawn, append across elastic respawns)
+    r0 = (logdir / "rank.0.out").read_text()
+    assert "incarnation=1" in r0, r0[-500:]
 
 
 INPROC_REINIT_WORKER = """
