@@ -25,8 +25,10 @@ import optax
 from _common import (enable_compilation_cache, make_recorder, require_tpu,
                      start_stall_watchdog)
 
-record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    "transformer_mfu.jsonl"))
+record = make_recorder(os.environ.get(
+    "HVD_BENCH_TRANSFORMER_OUT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "transformer_mfu.jsonl")))
 
 
 def fwd_flops_per_token(cfg, seq):
@@ -38,7 +40,7 @@ def fwd_flops_per_token(cfg, seq):
 
 def bench_lm(d_model=2048, n_layers=12, d_ff=8192, n_heads=16,
              vocab=32768, seq=1024, batch=8, scan_steps=8,
-             warmup=2, iters=4, remat=True):
+             warmup=2, iters=4, remat=True, xent_chunk=None):
     import horovod_tpu as hvd
     from horovod_tpu.models import transformer as T
     from bench import chip_peak_flops
@@ -46,7 +48,7 @@ def bench_lm(d_model=2048, n_layers=12, d_ff=8192, n_heads=16,
     cfg = T.TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, d_ff=d_ff, max_seq=seq, dtype=jnp.bfloat16,
-        remat=remat)
+        remat=remat, xent_chunk=xent_chunk)
     params = T.init(jax.random.PRNGKey(0), cfg)
     opt = hvd.DistributedOptimizer(optax.sgd(1e-3, momentum=0.9))
     opt_state = opt.init(params)
@@ -61,18 +63,25 @@ def bench_lm(d_model=2048, n_layers=12, d_ff=8192, n_heads=16,
 
     def step(params, opt_state, tokens):
         if scan_steps <= 1:
-            return one_step(params, opt_state, tokens)
+            params, opt_state, loss = one_step(params, opt_state, tokens)
+        else:
+            def body(carry, _):
+                p, s = carry
+                p, s, loss = one_step(p, s, tokens)
+                return (p, s), loss
 
-        def body(carry, _):
-            p, s = carry
-            p, s, loss = one_step(p, s, tokens)
-            return (p, s), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=scan_steps)
+            loss = losses[-1]
+        return params, opt_state, jax.lax.pmean(loss, "hvd")
 
-        (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), None, length=scan_steps)
-        return params, opt_state, losses[-1]
+    # the distributed optimizer's fused allreduce rides the 'hvd' mesh
+    # axis — the step must run under the DP shard_map exactly like
+    # bench.py's ResNet step (caught by a CPU smoke: a bare jit leaves
+    # the axis unbound and the phase would have failed on the chip)
+    from horovod_tpu.parallel import data_parallel_step
 
-    compiled = jax.jit(step, donate_argnums=(0, 1))
+    compiled = data_parallel_step(step, batch_argnums=(2,))
     t_c0 = time.perf_counter()
     for _ in range(warmup):
         params, opt_state, loss = compiled(params, opt_state, tokens)
@@ -89,6 +98,7 @@ def bench_lm(d_model=2048, n_layers=12, d_ff=8192, n_heads=16,
     peak = chip_peak_flops()
     record(event="lm", d_model=d_model, n_layers=n_layers, d_ff=d_ff,
            seq=seq, batch=batch, scan=scan_steps, remat=remat,
+           xent_chunk=xent_chunk,
            tok_s=round(tok_s, 1), tflops=round(flops / 1e12, 2),
            mfu=round(flops / peak, 4), compile_s=round(compile_s, 1))
     return flops / peak
@@ -107,6 +117,9 @@ def main():
             dict(scan_steps=8),
             dict(scan_steps=1),
             dict(seq=2048, batch=4, scan_steps=8),
+            # chunked LM loss: same math, no [tokens, vocab] logits —
+            # measures its throughput cost next to the memory win
+            dict(scan_steps=8, xent_chunk=8192),
     ):
         try:
             # heartbeat: the watchdog budget covers THIS config's

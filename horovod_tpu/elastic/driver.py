@@ -63,6 +63,14 @@ class _SubprocessWorker(WorkerHandle):
             # a respawned incarnation must not interleave with this one
             for t in self._streams:
                 t.join(timeout=10)
+                if t.is_alive():
+                    # a forked child still holds the stdout pipe open: the
+                    # stream never EOFs, and a respawned incarnation may
+                    # interleave with it in the tee file
+                    LOG.warning(
+                        "worker output stream still open 10 s after exit "
+                        "(orphaned child holding the pipe?); tee file may "
+                        "interleave with the next incarnation")
             self._streams = []
         return rc
 

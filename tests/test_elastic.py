@@ -315,6 +315,7 @@ from horovod_tpu.elastic import ObjectState
 hvd.init()
 r = hvd.cross_rank()
 incarnation = int(os.environ["HOROVOD_ELASTIC_EPOCH"])
+print(f"ELASTIC-E2E-START rank={r} incarnation={incarnation}", flush=True)
 state = ObjectState(step=0)  # resumes from HOROVOD_ELASTIC_STORE
 
 while state.step < 6:
@@ -373,8 +374,10 @@ def test_elastic_crash_restart_end_to_end(tmp_path):
     # recovery really happened: the finishing incarnation is not the first
     assert all(i != "0" for _, _, i in done), done
     # per-rank tee files exist and carry BOTH incarnations of rank 0
-    # (fresh file on first spawn, append across elastic respawns)
+    # (fresh file on first spawn, append across elastic respawns): the
+    # first incarnation's START line must survive the respawn append
     r0 = (logdir / "rank.0.out").read_text()
+    assert "ELASTIC-E2E-START rank=0 incarnation=0" in r0, r0[-500:]
     assert "incarnation=1" in r0, r0[-500:]
 
 

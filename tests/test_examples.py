@@ -243,3 +243,27 @@ def test_scaling_harness_smoke():
     data = json.loads(line.split("BENCH-SCALING ")[1])
     assert [r["chips"] for r in data["rows"]] == [1, 2, 4, 8]
     assert data["rows"][0]["efficiency"] == 1.0
+
+
+def test_bench_transformer_tiny_smoke():
+    """The transformer measurement phase must at least run a tiny config
+    on CPU — a bare-jit regression here once left the 'hvd' axis unbound
+    and would have burned a whole TPU uptime window to find out."""
+    code = (
+        "import sys; sys.path.insert(0, 'benchmarks'); sys.path.insert(0, '.')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "from bench_transformer import bench_lm\n"
+        "m = bench_lm(d_model=32, n_layers=1, d_ff=64, n_heads=2,\n"
+        "             vocab=128, seq=32, batch=8, scan_steps=2,\n"
+        "             warmup=1, iters=1, xent_chunk=32)\n"
+        "assert m > 0\n"
+        "print('BT-SMOKE-OK')\n")
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".jsonl") as tmp:
+        # route the recorder away from the real TPU evidence file
+        out = _run([sys.executable, "-c", code],
+                   env_extra={"HVD_BENCH_TRANSFORMER_OUT": tmp.name})
+    assert "BT-SMOKE-OK" in out
