@@ -10,9 +10,11 @@ HBM, which is the whole point on a bandwidth-bound chip. The kernel also
 returns ``(m, l)`` so ring attention can combine partial results from
 other chips' K/V shards exactly.
 
-Backward is a rematerialized standard attention VJP in plain XLA ops
-(saved q/k/v + the forward's logsumexp): correct and memory-light per
-block pair; a fused backward kernel is a later optimization.
+Backward is a rematerialized BLOCKWISE VJP: autodiff through
+``scan_stats`` — a ``lax.scan`` over K/V blocks with a checkpointed
+body — so both directions hold one [B, sq, block_k] score block, never
+the full matrix. Only q/k/v are residuals. A fused backward kernel is
+a later optimization.
 
 On non-TPU backends the kernel runs in Pallas interpret mode (tests on the
 virtual CPU mesh), so one code path serves everywhere.
@@ -190,6 +192,55 @@ def _lax_stats(q, k, v, causal: bool, causal_offset: int = 0):
     return o, m, l
 
 
+def scan_stats(q, k, v, causal: bool = True, causal_offset: int = 0,
+               block_k: int = 512):
+    """Blockwise stats attention: same (normalized o, m, l) contract as
+    the Pallas kernel and ``_lax_stats``, computed as a ``lax.scan`` over
+    K/V blocks with a rematerialized body — so BOTH autodiff directions
+    hold only one [B, sq, block_k] score block, never the full
+    [B, sq, sk] matrix. This is the memory-honest backward for the
+    flash forward (the dense VJP it replaces materialized the full
+    score matrix, defeating the kernel's point for long shards)."""
+    B, sq, d = q.shape
+    sk = k.shape[1]
+    bk = min(block_k, sk)
+    while sk % bk:
+        # shrink to a divisor rather than silently falling back to the
+        # dense path (which would materialize the full score matrix)
+        bk -= 1
+    n = sk // bk
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32)
+    kb = k.reshape(B, n, bk, d).swapaxes(0, 1)
+    vb = v.reshape(B, n, bk, d).swapaxes(0, 1)
+    rows = lax.broadcasted_iota(jnp.int32, (sq, bk), 0)
+    cols0 = lax.broadcasted_iota(jnp.int32, (sq, bk), 1)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        kj, vj, j = inp
+        sblk = jnp.einsum("bqd,bkd->bqk", qf,
+                          kj.astype(jnp.float32)) * scale
+        if causal:
+            mask = rows >= (j * bk + cols0) + causal_offset
+            sblk = jnp.where(mask[None], sblk, NEG_INF)
+        m_new = jnp.maximum(m, sblk.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(sblk - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = (acc * alpha[..., None]
+               + jnp.einsum("bqk,bkd->bqd", p, vj.astype(jnp.float32)))
+        return (m_new, l, acc), None
+
+    init = (jnp.full((B, sq), NEG_INF, jnp.float32),
+            jnp.zeros((B, sq), jnp.float32),
+            jnp.zeros((B, sq, d), jnp.float32))
+    (m, l, acc), _ = lax.scan(jax.checkpoint(body), init,
+                              (kb, vb, jnp.arange(n)))
+    o = (acc / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+    return o, m, l
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def attention_stats(q, k, v, causal: bool = True, block_q: int = 512,
                     block_k: int = 512, causal_offset: int = 0):
@@ -207,8 +258,10 @@ def _stats_fwd(q, k, v, causal, block_q, block_k, causal_offset):
 
 def _stats_bwd(causal, block_q, block_k, causal_offset, res, cts):
     q, k, v = res
+    # blockwise recompute: never materializes [B, sq, sk]
     _, vjp = jax.vjp(
-        lambda a, b, c: _lax_stats(a, b, c, causal, causal_offset), q, k, v)
+        lambda a, b, c: scan_stats(a, b, c, causal, causal_offset, block_k),
+        q, k, v)
     return vjp(cts)
 
 
@@ -217,28 +270,16 @@ attention_stats.defvjp(_stats_fwd, _stats_bwd)
 
 def _fwd(q, k, v, causal, block_q, block_k):
     o, m, l = _flash_fwd(q, k, v, causal, block_q, block_k)
-    # logsumexp per row: enough to rebuild p exactly in the backward
-    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
-    return o, (q, k, v, o, lse)
+    # only the inputs are residuals: the blockwise VJP recomputes its
+    # own stats, so o/lse must not stay live across fwd->bwd
+    return o, (q, k, v)
 
 
 def _bwd(causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])                       # [B, sq, sk] f32
-    do_f = do.astype(jnp.float32)
-    o_f = o.astype(jnp.float32)
-    dv = jnp.einsum("bqk,bqd->bkd", p, do_f)
-    dp = jnp.einsum("bqd,bkd->bqk", do_f, v.astype(jnp.float32))
-    delta = jnp.sum(do_f * o_f, axis=-1)                  # [B, sq]
-    ds = p * (dp - delta[..., None]) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: scan_stats(a, b, c, causal, 0, block_k)[0], q, k, v)
+    return vjp(do)
 
 
 flash_attention.defvjp(_fwd, _bwd)
