@@ -108,7 +108,8 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         raise ValueError(
             f"sequence lengths ({sq}, {sk}) must be divisible by the block "
             f"sizes ({bq}, {bk}); pick block_q/block_k that tile the "
-            "sequence or use the XLA fallback (_lax_stats)")
+            "sequence or use the blockwise XLA fallback (scan_stats / "
+            "use_flash=False)")
     nq, nk = sq // bq, sk // bk
     scale = d ** -0.5
 
@@ -204,10 +205,11 @@ def scan_stats(q, k, v, causal: bool = True, causal_offset: int = 0,
     B, sq, d = q.shape
     sk = k.shape[1]
     bk = min(block_k, sk)
-    while sk % bk:
-        # shrink to a divisor rather than silently falling back to the
-        # dense path (which would materialize the full score matrix)
-        bk -= 1
+    if sk % bk:
+        # largest divisor of sk that is <= block_k: stays blockwise for
+        # any length without degenerating to tiny blocks (a decrement
+        # loop could land on bk=1 for near-prime lengths)
+        bk = max(d_ for d_ in range(1, bk + 1) if sk % d_ == 0)
     n = sk // bk
     scale = d ** -0.5
     qf = q.astype(jnp.float32)
@@ -232,9 +234,11 @@ def scan_stats(q, k, v, causal: bool = True, causal_offset: int = 0,
                + jnp.einsum("bqk,bkd->bqd", p, vj.astype(jnp.float32)))
         return (m_new, l, acc), None
 
-    init = (jnp.full((B, sq), NEG_INF, jnp.float32),
-            jnp.zeros((B, sq), jnp.float32),
-            jnp.zeros((B, sq, d), jnp.float32))
+    # init derives from the data so its device-varying (vma) type matches
+    # the body outputs when traced inside a shard_map (constants are
+    # replication-typed and lax.scan demands equal carry types)
+    zrow = qf[..., 0] * 0.0                       # [B, sq], varies like q
+    init = (zrow + NEG_INF, zrow, qf * 0.0)
     (m, l, acc), _ = lax.scan(jax.checkpoint(body), init,
                               (kb, vb, jnp.arange(n)))
     o = (acc / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)

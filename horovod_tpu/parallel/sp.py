@@ -91,7 +91,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
     ``use_flash=None`` auto-selects the Pallas kernel on TPU and the
     differentiable XLA fallback elsewhere.
     """
-    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
+    from ..ops.pallas.flash_attention import attention_stats, scan_stats
 
     use_flash = _auto_flash(q.shape[1], block_q, block_k, use_flash)
     axis = axis_name
@@ -99,7 +99,9 @@ def ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
     def stats(qf, kf, vf, causal):
         if use_flash:
             return attention_stats(qf, kf, vf, causal, block_q, block_k)
-        return _lax_stats(qf, kf, vf, causal)
+        # blockwise fallback: same [*, block_k]-bounded memory as the
+        # kernel path, both autodiff directions
+        return scan_stats(qf, kf, vf, causal, 0, block_k)
 
     def round_stats(qf, kf, vf, r, i, j):
         # causal block cases: diagonal (r==0) → triangular; j<i → full;
@@ -143,7 +145,7 @@ def striped_ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
     inside a shard_map over ``axis_name``; outputs stay striped (invert
     with `unstripe_tokens` after gathering).
     """
-    from ..ops.pallas.flash_attention import _lax_stats, attention_stats
+    from ..ops.pallas.flash_attention import attention_stats, scan_stats
 
     use_flash = _auto_flash(q.shape[1], block_q, block_k, use_flash)
 
@@ -151,7 +153,7 @@ def striped_ring_attention(q, k, v, axis_name: str = "sp", use_flash=None,
         if use_flash:
             return attention_stats(qf, kf, vf, True, block_q, block_k,
                                    offset)
-        return _lax_stats(qf, kf, vf, True, offset)
+        return scan_stats(qf, kf, vf, True, offset, block_k)
 
     def round_stats(qf, kf, vf, r, i, j):
         # j <= i: inclusive diagonal; j > i: strict. Both are real
