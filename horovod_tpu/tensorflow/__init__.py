@@ -167,14 +167,32 @@ def grouped_allreduce(tensors, average=None, device_dense="",
     # path hot; unnamed calls get a unique base so concurrent groups can't
     # collide on the in-flight name guard
     base = name or f"grouped.tf.noname.{next(_group_counter)}"
-    comp = [compression.compress(t) for t in tensors]
-    hs = [_core.allreduce_async(_to_np(t), average, f"{base}.{i}", op=op,
-                                prescale_factor=prescale_factor,
-                                postscale_factor=postscale_factor,
-                                process_set=process_set)
-          for i, (t, _) in enumerate(comp)]
-    return [compression.decompress(_from_np(_core.synchronize(h), t.dtype), c)
-            for h, (t, c) in zip(hs, comp)]
+
+    @tf.custom_gradient
+    def _op(*ts):
+        comp = [compression.compress(t) for t in ts]
+        hs = [_core.allreduce_async(_to_np(t), average, f"{base}.{i}", op=op,
+                                    prescale_factor=prescale_factor,
+                                    postscale_factor=postscale_factor,
+                                    process_set=process_set)
+              for i, (t, _) in enumerate(comp)]
+        outs = [compression.decompress(
+                    _from_np(_core.synchronize(h), t.dtype), c)
+                for h, (t, c) in zip(hs, comp)]
+
+        def grad(*dys):
+            # gradient of a grouped allreduce is a grouped allreduce of
+            # the cotangents with the same op (reference grouped grad
+            # registration)
+            return grouped_allreduce(
+                list(dys), average=average, compression=compression,
+                op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                name=f"{base}.grad", process_set=process_set)
+
+        return tuple(outs), grad
+
+    return list(_op(*tensors))
 
 
 def allgather(tensor, name: Optional[str] = None,

@@ -340,6 +340,29 @@ class _AlltoallOp(torch.autograd.Function):
         return back, None, None, None
 
 
+class _GroupedAllreduceOp(torch.autograd.Function):
+    """Differentiable grouped allreduce (reference mpi_ops.py grouped
+    gradient registration): the backward grouped-allreduces all
+    cotangents as one fused batch, like the forward."""
+
+    @staticmethod
+    def forward(ctx, average, name, op, prescale, postscale, ps, *tensors):
+        ctx.meta = (average, name, op, prescale, postscale, ps)
+        hs = grouped_allreduce_async(list(tensors), average, name, op,
+                                    prescale, postscale, ps)
+        return tuple(synchronize(h) for h in hs)
+
+    @staticmethod
+    def backward(ctx, *dys):
+        average, name, op, prescale, postscale, ps = ctx.meta
+        red = grouped_allreduce(
+            [d.contiguous() for d in dys], average=average,
+            name=f"{name}.grad" if name else None, op=op,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=ps)
+        return (None,) * 6 + tuple(red)
+
+
 # --- sync wrappers ----------------------------------------------------------
 
 def allreduce(tensor, average=None, name=None, op=None,
@@ -368,6 +391,12 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
                       process_set=None):
     comp = [compression.compress(t) for t in tensors]
+    if any(_grad_wanted(c[0]) for c in comp):
+        outs = _GroupedAllreduceOp.apply(
+            average, name, op, prescale_factor, postscale_factor,
+            process_set, *[c[0] for c in comp])
+        return [compression.decompress(o, c[1])
+                for o, c in zip(outs, comp)]
     hs = grouped_allreduce_async([c[0] for c in comp], average, name, op,
                                  prescale_factor, postscale_factor,
                                  process_set)

@@ -293,3 +293,16 @@ def test_broadcast_global_variables_tf2_gating():
     collection exists (reference functions.py surface, honestly gated)."""
     with pytest.raises(RuntimeError, match="broadcast_variables"):
         hvd.broadcast_global_variables(0)
+
+
+def test_grouped_allreduce_gradient():
+    """Grouped allreduce participates in the tape; each member's gradient
+    is the (grouped-)allreduced cotangent (reference grouped grad)."""
+    a = tf.Variable([1.0, 2.0])
+    b = tf.Variable([[3.0]])
+    with tf.GradientTape() as tape:
+        ra, rb = hvd.grouped_allreduce([a, b], op=hvd.Sum, name="tfg.gar")
+        loss = tf.reduce_sum(ra) + 4.0 * tf.reduce_sum(rb)
+    da, db = tape.gradient(loss, [a, b])
+    np.testing.assert_allclose(da.numpy(), [1.0, 1.0])
+    np.testing.assert_allclose(db.numpy(), [[4.0]])

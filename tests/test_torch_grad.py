@@ -376,3 +376,18 @@ def test_distributed_optimizer_process_set(tmp_path):
     script.write_text(PROCESS_SET_OPT_WORKER)
     rc = run_commandline(["-np", "2", sys.executable, str(script)])
     assert rc == 0
+
+
+def test_grouped_allreduce_grad():
+    """Reference test_horovod_grouped_allreduce_grad: cotangents of all
+    group members allreduce back as one fused batch."""
+    xs = [torch.arange(3, dtype=torch.float32, requires_grad=True),
+          torch.ones(2, 2, requires_grad=True)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="tg.gar")
+    (outs[0].sum() + (outs[1] * 2.0).sum()).backward()
+    np.testing.assert_allclose(xs[0].grad.numpy(), np.ones(3))
+    np.testing.assert_allclose(xs[1].grad.numpy(), np.full((2, 2), 2.0))
+    # no-grad inputs keep the async fused path
+    outs = hvd.grouped_allreduce([torch.ones(2), torch.ones(3)],
+                                 op=hvd.Sum, name="tg.gar2")
+    assert not any(o.requires_grad for o in outs)
