@@ -263,17 +263,20 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
         LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
 
 
-def shutdown():
+def shutdown(drain: bool = True):
     """Tear down (reference: horovod_shutdown, operations.cc:728).
 
     Pending async operations fail with HorovodInternalError, mirroring
-    FinalizeTensorQueue (tensor_queue.h:35).
+    FinalizeTensorQueue (tensor_queue.h:35). ``drain=False`` skips the
+    cooperative shutdown barrier — for error-recovery teardown
+    (elastic reinit), where waiting on a broken lockstep only delays
+    the new generation.
     """
     with _ctx.lock:
         if not _ctx.initialized:
             return
         if _ctx.runtime is not None:
-            _ctx.runtime.stop()
+            _ctx.runtime.stop(drain=drain)
             _ctx.runtime = None
         if _ctx.timeline is not None:
             _ctx.timeline.close()

@@ -49,7 +49,7 @@ def _reinitialize():
     os.environ["HOROVOD_ELASTIC_GEN"] = str(
         int(os.environ.get("HOROVOD_ELASTIC_GEN", "0")) + 1)
 
-    ctx_mod.shutdown()
+    ctx_mod.shutdown(drain=False)
     clear_eager_cache()
     ctx_mod.init()
 

@@ -168,7 +168,8 @@ class KVController:
             self._coord.start()
 
     def negotiate(self, pending: dict[str, list],
-                  joined: bool = False) -> dict:
+                  joined: bool = False,
+                  shutting_down: bool = False) -> dict:
         """Submit this process's ready set; return the round response dict
         (``ready`` ordered names, ``errors`` per-name, ``sigs`` for ready
         names, ``join_done`` last-joined rank or None). Blocks for the
@@ -186,7 +187,7 @@ class KVController:
         try:
             payload = json.dumps(
                 {"e": [[n, sig] for n, sig in pending.items()],
-                 "j": bool(joined)}).encode()
+                 "j": bool(joined), "sd": bool(shutting_down)}).encode()
             if payload == self._last_payload:
                 wire = self.SAME_AS_LAST
                 self.fast_rounds += 1
@@ -213,6 +214,9 @@ class KVController:
         resp.setdefault("errors", {})
         resp.setdefault("sigs", {})
         resp.setdefault("join_done", None)
+        if resp.get("shutdown_done"):
+            # every rank has requested shutdown: the lockstep is over
+            self.broken = True
         if resp.get("params") is not None and self.on_params is not None:
             # reference SynchronizeParameters (controller.cc:39-53): tuned
             # knobs ride the response, so every rank applies them at the
@@ -224,6 +228,28 @@ class KVController:
             except Exception as e:  # tuning must never break the lockstep
                 LOG.warning("on_params failed: %s", e)
         return resp
+
+    def drain_shutdown(self):
+        """Reference shutdown barrier (operations.cc RunLoopOnce exits
+        only when EVERY rank requested shutdown): keep the lockstep
+        alive with empty submissions + the sd flag until the
+        coordinator announces shutdown_done. Rounds keep advancing at
+        the cycle pace of still-working ranks, so a finished rank keeps
+        serving (rank 0's coordinator included) instead of starving
+        peers that still have process-set-scoped work. Rounds use the
+        normal response timeout — a peer mid-long-compile is slow, not
+        dead, and ending the drain early would starve it (a genuinely
+        crashed peer costs one response timeout here, the same as in
+        any other stalled round)."""
+        if self.broken:
+            return
+        try:
+            while True:
+                resp = self.negotiate({}, shutting_down=True)
+                if resp.get("shutdown_done"):
+                    return
+        except Exception:
+            return  # peer gone or round timed out: nothing left to serve
 
     def submit_params(self, params: dict):
         """Rank 0 only: hand tuned knobs to the coordinator; they ride the
@@ -264,6 +290,7 @@ class _Coordinator(threading.Thread):
         self.errors: dict[str, str] = {}
         self._pending_params = None
         self._params_lock = threading.Lock()
+        self._down: set[int] = set()
         # rank -> last full submission (for SAME_AS_LAST fast-path decode)
         self._last_submission: dict[int, dict] = {}
         # join tracking (reference JoinOp: joined_size / joined ranks,
@@ -379,6 +406,8 @@ class _Coordinator(threading.Thread):
                     if msg.get("j") and k not in self._joined:
                         self._joined.add(k)
                         self._last_joined_rank = k
+                    if msg.get("sd"):
+                        self._down.add(k)
                     for name, sig in msg.get("e", []):
                         self._increment(name, sig, k)
                 self._check_stalled_tensors()
@@ -412,6 +441,10 @@ class _Coordinator(threading.Thread):
                     self._stall_warned.discard(n)
                 resp_dict = {"ready": ready, "sigs": sigs,
                              "errors": errors, "join_done": join_done}
+                if len(self._down) == self.size:
+                    # reference: shutdown only when every rank requested
+                    # it (operations.cc:728 horovod_shutdown semantics)
+                    resp_dict["shutdown_done"] = True
                 with self._params_lock:
                     if self._pending_params is not None:
                         resp_dict["params"] = self._pending_params
@@ -421,6 +454,8 @@ class _Coordinator(threading.Thread):
                 resp_published = True
                 if r >= 2:
                     self.client.delete_scope(_ctl_scope(r - 2))
+                if resp_dict.get("shutdown_done"):
+                    return  # all ranks drained: the lockstep is over
                 r += 1
             except Exception as e:
                 if self._stop_evt.is_set():

@@ -273,14 +273,25 @@ class BackgroundRuntime:
                                         name="hvd-cycle")
         self._thread.start()
 
-    def stop(self):
+    def stop(self, drain: bool = True):
         self._stop.set()
         self._wake.set()
-        if self.controller:
-            self.controller.stop()
+        cycle_exited = True
         if self._thread:
             self._thread.join(timeout=10)
+            cycle_exited = not self._thread.is_alive()
             self._thread = None
+        if self.controller:
+            # reference shutdown barrier: keep the lockstep (and rank 0's
+            # coordinator) alive until EVERY rank has requested shutdown —
+            # a finished rank exiting early would starve peers that still
+            # have process-set-scoped rounds to run. Never drain while the
+            # cycle thread may still be mid-negotiate (two threads on one
+            # controller would corrupt the round lockstep), and not on
+            # error-recovery teardown (drain=False).
+            if drain and cycle_exited:
+                self.controller.drain_shutdown()
+            self.controller.stop()
         for e in list(self._pending.values()) + self.queue.finalize():
             self.handles.mark_done(
                 e.handle, exc=HorovodInternalError("Horovod has been shut down"))

@@ -305,6 +305,11 @@ FUZZ_WORKER = textwrap.dedent("""
     hvd.init()
     r = hvd.cross_rank()
     nproc = hvd.cross_size()
+    # sub process sets: some ops run scoped to a singleton PROCESS set
+    # (only its member submits — the coordinator must not wait on the
+    # world). Chip indices map to processes: with 2 local chips per
+    # process, process r owns chips [2r, 2r+1] — a set of one process.
+    mine = hvd.add_process_set([2 * r, 2 * r + 1], name=f"fz.solo{r}")
 
     # same op sequence on every rank (shared seed), rank-local submission
     # ORDER (the negotiation's whole job is reordering these correctly)
@@ -312,7 +317,7 @@ FUZZ_WORKER = textwrap.dedent("""
     N = 120
     plan = []
     for i in range(N):
-        op = rng.choice(["allreduce", "allgather", "broadcast"])
+        op = rng.choice(["allreduce", "allgather", "broadcast", "ps_ar"])
         dt = rng.choice([np.float32, np.int32, np.float16])
         n = int(rng.randint(1, 9)) * 4
         plan.append((i, op, dt, n))
@@ -323,7 +328,13 @@ FUZZ_WORKER = textwrap.dedent("""
     handles = {}
     for i in order:
         _, op, dt, n = plan[i]
-        if op == "allreduce":
+        if op == "ps_ar":
+            # scoped to THIS rank's singleton set; same user name on both
+            # ranks' sets is legal (per-set message tables)
+            x = np.full((n,), (r + 1) * 10, dtype=dt)
+            handles[i] = hvd.allreduce_async(x, op=hvd.Sum, name=f"fz{i}",
+                                             process_set=mine)
+        elif op == "allreduce":
             x = np.full((n,), r + 1, dtype=dt)
             handles[i] = hvd.allreduce_async(x, op=hvd.Sum, name=f"fz{i}")
         elif op == "allgather":
@@ -338,7 +349,10 @@ FUZZ_WORKER = textwrap.dedent("""
     for i, h in handles.items():
         _, op, dt, n = plan[i]
         out = np.asarray(hvd.synchronize(h))
-        if op == "allreduce":
+        if op == "ps_ar":
+            # singleton set: identity, no cross-rank mixing
+            assert np.all(out.astype(np.float32) == (r + 1) * 10), (i, out[:4])
+        elif op == "allreduce":
             assert out.shape == (n,) and np.all(
                 out.astype(np.float32) == 3.0), (i, out[:4])
         elif op == "allgather":
