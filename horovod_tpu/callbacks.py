@@ -13,7 +13,7 @@ reference callback it replaces. The LR schedules are optax-composable.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import numpy as np

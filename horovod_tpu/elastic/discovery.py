@@ -10,8 +10,6 @@ from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Optional
-
 from ..runner.hosts import HostInfo
 
 

@@ -23,7 +23,6 @@ Both paths share one public API, dispatched on whether the input is a tracer.
 
 from __future__ import annotations
 
-import functools
 from enum import IntEnum
 import logging
 from typing import Optional, Sequence

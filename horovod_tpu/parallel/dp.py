@@ -11,7 +11,7 @@ thread + fusion buffer, operations.cc:587 + fusion_buffer_manager.h).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

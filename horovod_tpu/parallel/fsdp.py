@@ -22,7 +22,7 @@ collective latency than it saves in HBM.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ the Store abstraction (reference spark/common/store.py).
 from __future__ import annotations
 
 import io
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 from .common.store import Store
 from .common.util import dataframe_to_numpy, train_val_split

@@ -8,8 +8,6 @@ root's values; objects ride the core's pickle-based collectives.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 import tensorflow as tf
 

@@ -14,10 +14,12 @@ is the *eager* runtime's fusion threshold and cycle time. Design:
 - Rank 0 owns the GP: it scores its own smoothed bytes/sec (symmetric in
   data-parallel steady state), observes (params, score) pairs, and proposes
   the next point by maximizing expected improvement over log-scaled bounds.
-- Every proposal is published to the rendezvous KV store (scope
-  ``autotune``, key ``latest``); other ranks poll it cheaply each sample
-  and apply any newer proposal. After ``max_samples`` the best observed
-  point is published as final and tuning stops everywhere.
+- Proposals ride the negotiated RESPONSE (KVController.submit_params →
+  runtime._apply_tuned_params): every rank — rank 0 included — applies
+  them at response receipt, the same round boundary everywhere. This is
+  load-bearing for the hierarchical knobs, which change the XLA program
+  built for a negotiated tensor. After ``max_samples`` the best observed
+  point rides a final response and tuning stops everywhere.
 - Single-process (no controller): same GP, applied locally.
 
 The GP here is an original small implementation: RBF kernel, fixed noise,
@@ -27,7 +29,6 @@ Cholesky solve, EI acquisition maximized over a quasi-random candidate set
 
 from __future__ import annotations
 
-import json
 import logging
 import math
 import time
@@ -143,7 +144,8 @@ class Autotuner:
 
     ``sample()`` is called from the background cycle loop every N working
     cycles on every rank; only rank 0 (or a controller-less single process)
-    updates the GP and proposes; other ranks poll + apply.
+    updates the GP and proposes; other ranks apply proposals as they
+    arrive on negotiated responses.
     """
 
     def __init__(self, runtime, log_path: str = "", warmup_samples: int = 3,
@@ -160,7 +162,7 @@ class Autotuner:
         ctl = runtime.controller
         self._rank = ctl.rank if ctl is not None else 0
         self._opt = (BayesianOptimizer(dims=_DIMS)
-             if self._rank == 0 else None)
+                     if self._rank == 0 else None)
         if log_path:
             with open(log_path, "w") as f:
                 f.write("sample,fusion_bytes,cycle_ms,hier_allreduce,hier_allgather,score_bytes_per_sec\n")
