@@ -263,3 +263,77 @@ def test_checkpoint_format_transition_and_crash_rotation(tmp_path):
         os.rename(p, p + ".old")
         assert ckpt.exists(p)
         np.testing.assert_allclose(ckpt.load_pytree(p)["a"], np.arange(3.0))
+
+
+def test_bench_parent_json_survives_stderr_flood(monkeypatch, capsys, tmp_path):
+    """Round-3 post-mortem: the driver parses the tail of bench.py's
+    combined output, and forwarding child stderr after the JSON line let
+    XLA warnings flood it past parseability (BENCH_r03.json parsed: null
+    at rc=0). 100 KB of fake child stderr must not displace the JSON
+    line from the final 500 bytes, and bench_result.json must hold the
+    same line."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+
+    json_line = _json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip", "value": 123.4,
+        "unit": "images/sec/chip", "mfu": 0.31, "vs_baseline": 1.19,
+        "extras": {"device": "fake"}})
+    flood = "E0000 fake XLA AOT cache warning line\n" * 2500  # ~100 KB
+
+    def fake_run(cmd, **kw):
+        if cmd[1] == "-c":  # the backend probe child
+            return subprocess.CompletedProcess(cmd, 0, "BENCH-PROBE-OK\n", "")
+        return subprocess.CompletedProcess(
+            cmd, 0, "some banner\n" + json_line + "\n", flood)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_RESULT_FILE", str(tmp_path / "bench_result.json"))
+    assert bench._parent_main() == 0
+    cap = capsys.readouterr()
+    combined = cap.err + cap.out  # stderr excerpt first, JSON last
+    assert combined[-500:].rstrip().endswith(json_line)
+    assert cap.out.rstrip().splitlines()[-1] == json_line
+    assert len(cap.err) < 1000  # the flood was capped, not forwarded
+    with open(tmp_path / "bench_result.json") as f:
+        assert _json.loads(f.read()) == _json.loads(json_line)
+
+
+def test_bench_parent_fallback_emits_parseable_json(monkeypatch, capsys, tmp_path):
+    """When the TPU child fails, the CPU fallback's JSON must still be
+    the last line and carry the fallback metadata."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+
+    calls = {"n": 0}
+
+    def fake_run(cmd, **kw):
+        if cmd[1] == "-c":
+            return subprocess.CompletedProcess(cmd, 0, "BENCH-PROBE-OK\n", "")
+        calls["n"] += 1
+        if calls["n"] == 1:  # TPU child: crashes, no JSON
+            return subprocess.CompletedProcess(cmd, 1, "", "tunnel wedged\n" * 50)
+        env = kw.get("env") or {}
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        line = _json.dumps({
+            "metric": "resnet50_images_per_sec_per_chip", "value": 8.0,
+            "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.08,
+            "extras": {"fallback_cpu": True}})
+        return subprocess.CompletedProcess(cmd, 0, line + "\n", "noise\n" * 1000)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_RESULT_FILE", str(tmp_path / "bench_result.json"))
+    assert bench._parent_main() == 0
+    cap = capsys.readouterr()
+    last = cap.out.rstrip().splitlines()[-1]
+    parsed = _json.loads(last)
+    assert parsed["extras"]["fallback_cpu"] is True
+    assert (cap.err + cap.out)[-500:].rstrip().endswith(last)
