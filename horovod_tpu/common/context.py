@@ -194,8 +194,38 @@ def _maybe_init_distributed():
             process_id=int(os.environ[env_schema.HOROVOD_TPU_PROCESS_ID]),
         )
         LOG.info("jax.distributed initialized via %s", coord)
+        _install_fatal_exit_hook()
     except Exception as e:
         LOG.warning("jax.distributed.initialize failed: %s", e)
+
+
+def _install_fatal_exit_hook():
+    """A distributed worker that dies of an unhandled exception must
+    EXIT, not linger: interpreter teardown destroys the jax.distributed
+    client, whose destructor blocks on the coordination-service shutdown
+    barrier until the surviving peers also exit (measured: a failing rank
+    stayed alive ~5 min while its healthy peer sat in a negotiation
+    poll). The launcher's first-failure kill (reference gloo_run.py:
+    263-271) can only fire once this process is actually gone — so after
+    reporting the error we flush and hard-exit before teardown reaches
+    that destructor. Normal completion and sys.exit() keep the clean
+    path (the barrier is then bounded by real rank skew)."""
+    import sys
+
+    prev = sys.excepthook
+
+    def hook(tp, val, tb):
+        try:
+            # inside the try: a raising prev hook (or a torn-down stderr
+            # pipe) must not skip the hard exit — lingering is the exact
+            # failure this hook exists to prevent
+            prev(tp, val, tb)
+            sys.stdout.flush()
+            sys.stderr.flush()
+        finally:
+            os._exit(1)
+
+    sys.excepthook = hook
 
 
 def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):

@@ -48,6 +48,9 @@ HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_GLOO_IFACE = "HOROVOD_GLOO_IFACE"
 HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+# per-job HMAC key authenticating every KV-store request/response
+# (reference runner/common/util/secret.py); launcher-minted, env-injected
+HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 
 # TPU-specific (new in this framework)
 HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"  # jax.distributed coordinator addr

@@ -252,6 +252,12 @@ def run_elastic(command: list[str], args) -> int:
 
     if not args.host_discovery_script:
         raise SystemExit("elastic mode requires --host-discovery-script")
+    # job secret must exist before the driver's RendezvousServer starts
+    # (the store binds its verification key at construction); slot_env's
+    # os.environ snapshot then carries it through every incarnation
+    from ..runner.secret import get_or_mint_env_secret
+
+    get_or_mint_env_secret()
     discovery = HostDiscoveryScript(args.host_discovery_script,
                                     default_slots=args.slots_per_host)
     driver = ElasticDriver(discovery, min_np=args.min_np or 1,

@@ -239,6 +239,9 @@ class RayExecutor:
         for rank, host in enumerate(hostnames):
             self.coordinator.register(host, rank)
         envs = self.coordinator.rank_envs()
+        from ..runner.secret import get_or_mint_env_secret
+
+        job_secret = get_or_mint_env_secret()  # before the server binds its key
         self._rendezvous = RendezvousServer()
         port = self._rendezvous.start()
         import socket
@@ -255,6 +258,7 @@ class RayExecutor:
         for rank, e in envs.items():
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR] = addr
             e[env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT] = str(port)
+            e[env_schema.HOROVOD_SECRET_KEY] = job_secret
             e[env_schema.HOROVOD_CONTROLLER] = "kv"
             e[env_schema.HOROVOD_TPU_COORDINATOR] = coord
             e[env_schema.HOROVOD_TPU_NUM_PROCESSES] = str(self.num_workers)

@@ -10,6 +10,14 @@ Same role here: the launcher starts one `RendezvousServer`; workers use
 ``jax.distributed.initialize``, and (multi-process eager mode) run the
 controller negotiation. Values are opaque bytes; keys are scoped
 ``scope/key``.
+
+Authentication: when a job secret is present (``HOROVOD_SECRET_KEY``,
+minted by the launcher — see runner/secret.py and the reference's
+runner/common/util/{secret,network}.py), every request carries an HMAC
+digest the store verifies before acting (403 otherwise), and every GET
+response carries a digest the client verifies before trusting — the
+negotiation control plane rejects writes and reads from anything that
+does not hold the key.
 """
 
 from __future__ import annotations
@@ -18,8 +26,17 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.error import HTTPError
 from urllib.parse import unquote
 from urllib.request import Request, urlopen
+
+from . import secret as _secret
+
+
+class KVAuthError(RuntimeError):
+    """A KV exchange failed authentication: either the store refused our
+    digest (key mismatch / tampered request) or a GET response's digest
+    did not verify (store impersonation / tampered value)."""
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -31,9 +48,25 @@ class _KVHandler(BaseHTTPRequestHandler):
     def _key(self):
         return unquote(self.path.lstrip("/"))
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        key = self.server.secret_key  # type: ignore[attr-defined]
+        if not key:
+            return True
+        return _secret.check_digest(
+            key, self.headers.get(_secret.DIGEST_HEADER),
+            self.command.encode(), self._key().encode(),
+            (self.headers.get("X-Exclude-Prefix") or "").encode(), body)
+
+    def _reject(self):
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_PUT(self):
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        if not self._authorized(body):
+            return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
         with store.cond:
             store.data[self._key()] = body
@@ -43,6 +76,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if not self._authorized():
+            return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
         key = self._key()
         timeout = float(self.headers.get("X-Timeout", "30"))
@@ -59,10 +94,16 @@ class _KVHandler(BaseHTTPRequestHandler):
             body = store.data[key]
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
+        skey = self.server.secret_key  # type: ignore[attr-defined]
+        if skey:
+            self.send_header(_secret.DIGEST_HEADER,
+                             _secret.response_digest(skey, key, body))
         self.end_headers()
         self.wfile.write(body)
 
     def do_DELETE(self):
+        if not self._authorized():
+            return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
         exclude = self.headers.get("X-Exclude-Prefix")
         with store.cond:
@@ -84,11 +125,18 @@ class _Store:
 
 class RendezvousServer:
     """Blocking-GET KV store over HTTP (reference RendezvousServer,
-    http_server.py:174)."""
+    http_server.py:174).
 
-    def __init__(self, port: int = 0):
+    ``secret_key=None`` (default) picks up the job secret from
+    ``HOROVOD_SECRET_KEY`` when the launcher minted one; pass an explicit
+    key to override. Without a key the store is open (standalone /
+    single-host test use)."""
+
+    def __init__(self, port: int = 0, secret_key: Optional[str] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._server.store = _Store()  # type: ignore[attr-defined]
+        self._server.secret_key = (  # type: ignore[attr-defined]
+            secret_key if secret_key is not None else _secret.env_secret())
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -110,29 +158,79 @@ class RendezvousServer:
 
 class KVStoreClient:
     """Client for RendezvousServer (role of the C++ HTTPStore,
-    gloo/http_store.cc:138)."""
+    gloo/http_store.cc:138). Signs requests and verifies GET responses
+    when a job secret is available (same default-from-env rule as the
+    server)."""
 
-    def __init__(self, addr: str, port: int):
+    def __init__(self, addr: str, port: int,
+                 secret_key: Optional[str] = None):
         self.base = f"http://{addr}:{port}"
+        self._secret = (secret_key if secret_key is not None
+                        else _secret.env_secret())
+
+    def _headers(self, method: str, path: str, body: bytes = b"",
+                 exclude: str = "") -> dict:
+        if not self._secret:
+            return {}
+        return {_secret.DIGEST_HEADER: _secret.request_digest(
+            self._secret, method, path, body, exclude)}
+
+    @staticmethod
+    def _raise_on_403(e: HTTPError, what: str):
+        if e.code == 403:
+            raise KVAuthError(
+                f"KV store refused {what}: HMAC digest rejected (secret "
+                "key mismatch — is HOROVOD_SECRET_KEY consistent across "
+                "the job?)") from e
+        raise
 
     def put(self, scope: str, key: str, value: bytes):
-        req = Request(f"{self.base}/{scope}/{key}", data=value, method="PUT")
-        urlopen(req, timeout=30).read()
+        path = f"{scope}/{key}"
+        req = Request(f"{self.base}/{path}", data=value, method="PUT",
+                      headers=self._headers("PUT", path, value))
+        try:
+            urlopen(req, timeout=30).read()
+        except HTTPError as e:
+            self._raise_on_403(e, f"PUT {path}")
 
     def get(self, scope: str, key: str, timeout: float = 30.0) -> bytes:
-        req = Request(f"{self.base}/{scope}/{key}", method="GET",
-                      headers={"X-Timeout": str(timeout)})
-        return urlopen(req, timeout=timeout + 10).read()
+        path = f"{scope}/{key}"
+        headers = {"X-Timeout": str(timeout)}
+        headers.update(self._headers("GET", path))
+        req = Request(f"{self.base}/{path}", method="GET", headers=headers)
+        try:
+            resp = urlopen(req, timeout=timeout + 10)
+        except HTTPError as e:
+            self._raise_on_403(e, f"GET {path}")
+        body = resp.read()
+        if self._secret and not _secret.check_digest(
+                self._secret, resp.headers.get(_secret.DIGEST_HEADER),
+                b"RESP", path.encode(), body):
+            raise KVAuthError(
+                f"GET {path}: response digest missing or invalid — the "
+                "value was tampered with in transit or the store does not "
+                "hold the job secret")
+        return body
 
     def delete_scope(self, scope: str):
-        req = Request(f"{self.base}/{scope}/", method="DELETE")
-        urlopen(req, timeout=30).read()
+        path = f"{scope}/"
+        req = Request(f"{self.base}/{path}", method="DELETE",
+                      headers=self._headers("DELETE", path))
+        try:
+            urlopen(req, timeout=30).read()
+        except HTTPError as e:
+            self._raise_on_403(e, f"DELETE {path}")
 
     def delete_prefix(self, prefix: str, exclude: Optional[str] = None):
         """Delete every key under ``prefix`` except those under
         ``exclude`` (stale-generation GC that must not race the live
         namespace's fresh keys)."""
-        headers = {"X-Exclude-Prefix": exclude} if exclude else {}
+        headers = self._headers("DELETE", prefix, exclude=exclude or "")
+        if exclude:
+            headers["X-Exclude-Prefix"] = exclude
         req = Request(f"{self.base}/{prefix}", method="DELETE",
                       headers=headers)
-        urlopen(req, timeout=30).read()
+        try:
+            urlopen(req, timeout=30).read()
+        except HTTPError as e:
+            self._raise_on_403(e, f"DELETE {prefix}")

@@ -151,6 +151,12 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
     --output-filename)."""
     if output_filename:
         os.makedirs(output_filename, exist_ok=True)
+    # mint (or reuse) the job secret BEFORE the server starts: the store
+    # reads it from env, and slot_env's os.environ snapshot delivers it
+    # to every worker (reference secret.py + gloo_run.py:65 injection)
+    from .secret import get_or_mint_env_secret
+
+    get_or_mint_env_secret()
     rendezvous = RendezvousServer()
     rendezvous.start()
     this_host = socket.gethostname()

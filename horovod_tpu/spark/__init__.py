@@ -74,6 +74,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     for rank, h in enumerate(hosts):
         coord.register(h, rank)
     envs = coord.rank_envs()
+    from ..runner.secret import get_or_mint_env_secret
+
+    job_secret = get_or_mint_env_secret()  # before the server binds its key
     rendezvous = RendezvousServer()
     port = rendezvous.start()
     import socket
@@ -84,6 +87,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         e.update(base_env)
         e[env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR] = addr
         e[env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT] = str(port)
+        e[env_schema.HOROVOD_SECRET_KEY] = job_secret
 
     fn_args, fn_kwargs = args, kwargs or {}
 

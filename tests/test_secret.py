@@ -1,0 +1,127 @@
+"""Control-plane authentication (reference runner/common/util/secret.py +
+network.py:60-100): the KV store must refuse unauthenticated writes, and
+workers must refuse tampered responses — with a wrong-key worker failing
+the whole job through the real launcher."""
+
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import secret
+from horovod_tpu.runner.http_server import (KVAuthError, KVStoreClient,
+                                            RendezvousServer)
+
+
+def test_digest_parts_are_length_prefixed():
+    k = secret.make_secret_key()
+    assert secret.compute_digest(k, b"a", b"bc") != secret.compute_digest(k, b"ab", b"c")
+    assert secret.compute_digest(k, b"a", b"b") == secret.compute_digest(k, b"a", b"b")
+    assert not secret.check_digest(k, None, b"x")
+    assert secret.check_digest(k, secret.compute_digest(k, b"x"), b"x")
+
+
+def test_unauthenticated_put_refused():
+    key = secret.make_secret_key()
+    srv = RendezvousServer(secret_key=key)
+    port = srv.start()
+    try:
+        rogue = KVStoreClient("127.0.0.1", port, secret_key="")
+        with pytest.raises(KVAuthError):
+            rogue.put("negotiate", "round.0", b"poison")
+        # the poisoned key must not exist for a legitimate reader
+        good = KVStoreClient("127.0.0.1", port, secret_key=key)
+        with pytest.raises(Exception):  # blocking GET times out -> 404
+            good.get("negotiate", "round.0", timeout=0.3)
+        # and the legitimate path round-trips
+        good.put("negotiate", "round.0", b"real")
+        assert good.get("negotiate", "round.0", timeout=2) == b"real"
+    finally:
+        srv.stop()
+
+
+def test_wrong_key_put_and_get_refused():
+    srv = RendezvousServer(secret_key=secret.make_secret_key())
+    port = srv.start()
+    try:
+        wrong = KVStoreClient("127.0.0.1", port,
+                              secret_key=secret.make_secret_key())
+        with pytest.raises(KVAuthError):
+            wrong.put("scope", "k", b"v")
+        with pytest.raises(KVAuthError):
+            wrong.get("scope", "k", timeout=1)
+    finally:
+        srv.stop()
+
+
+def test_unauthenticated_delete_refused():
+    key = secret.make_secret_key()
+    srv = RendezvousServer(secret_key=key)
+    port = srv.start()
+    try:
+        good = KVStoreClient("127.0.0.1", port, secret_key=key)
+        good.put("scope", "k", b"v")
+        with pytest.raises(KVAuthError):
+            KVStoreClient("127.0.0.1", port, secret_key="").delete_scope("scope")
+        assert good.get("scope", "k", timeout=2) == b"v"
+        good.delete_scope("scope")
+    finally:
+        srv.stop()
+
+
+def test_tampered_response_rejected():
+    """A store that does not hold the job secret (an impersonator, or a
+    value altered in transit) cannot satisfy a keyed client's GET."""
+    key = secret.make_secret_key()
+    # impersonating store: no key -> serves unsigned responses
+    srv = RendezvousServer(secret_key="")
+    port = srv.start()
+    try:
+        open_client = KVStoreClient("127.0.0.1", port, secret_key="")
+        open_client.put("negotiate", "resp", b"forged response")
+        victim = KVStoreClient("127.0.0.1", port, secret_key=key)
+        with pytest.raises(KVAuthError, match="digest missing or invalid"):
+            victim.get("negotiate", "resp", timeout=2)
+    finally:
+        srv.stop()
+
+
+def test_response_digest_is_path_bound():
+    """A signed value for one key must not verify as the value of
+    another (splice replay)."""
+    key = secret.make_secret_key()
+    d = secret.response_digest(key, "scope/a", b"v")
+    assert not secret.check_digest(key, d, b"RESP", b"scope/b", b"v")
+    assert secret.check_digest(key, d, b"RESP", b"scope/a", b"v")
+
+
+WRONG_KEY_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import env as env_schema
+
+    rank = int(os.environ[env_schema.HOROVOD_RANK])
+    if rank == 1:
+        # rogue/poisoned worker: holds a key the store did not mint
+        os.environ[env_schema.HOROVOD_SECRET_KEY] = "0" * 64
+    hvd.init()
+    h = hvd.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="x")
+    out = hvd.synchronize(h)
+    print("unexpectedly completed", rank, flush=True)
+""")
+
+
+def test_wrong_key_worker_fails_the_job(tmp_path):
+    """End-to-end through the real launcher: a worker whose KV traffic
+    fails authentication cannot negotiate, and the job exits nonzero
+    (reference behavior: digest mismatch kills the run)."""
+    from horovod_tpu.runner.launch import run_commandline
+
+    script = tmp_path / "worker.py"
+    script.write_text(WRONG_KEY_WORKER)
+    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    assert rc != 0
