@@ -35,7 +35,7 @@ phase() {  # phase <name> <timeout_s> <cmd...>
 }
 
 all_done() {
-  for m in resnet probe transformer sweep bench memory; do
+  for m in resnet eager timeline probe transformer sweep bench torchshim memory; do
     [ -f "benchmarks/markers/$m.done" ] || return 1
   done
   return 0
@@ -57,11 +57,17 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     # via tmp+mv only after validation, so a fallback/truncated run
     # never leaves a bad bench_r3_chip.json behind. The memory phase
     # records HBM CompiledMemoryStats evidence last.
+    # resnet first (headline + warms the bench compile cache), then the
+    # two cheap VERDICT-r3 artifact phases (eager GB/s rows, on-chip
+    # timeline/XPlane capture) so even a minutes-long window banks them.
     phase resnet     2700  python benchmarks/resnet_phase.py     && \
+    phase eager       900  python benchmarks/eager_phase.py      && \
+    phase timeline    600  python benchmarks/timeline_phase.py   && \
     phase probe       900  python benchmarks/probe_conv.py       && \
     phase transformer 2700 python benchmarks/bench_transformer.py && \
     phase sweep      3600  python benchmarks/mfu_campaign.py     && \
-    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r3_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r3_chip.tmp && ! grep -q fallback benchmarks/.bench_r3_chip.tmp && mv benchmarks/.bench_r3_chip.tmp benchmarks/bench_r3_chip.json' && \
+    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r4_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r4_chip.tmp && ! grep -q fallback benchmarks/.bench_r4_chip.tmp && mv benchmarks/.bench_r4_chip.tmp benchmarks/bench_r4_chip.json' && \
+    phase torchshim   900  python benchmarks/torch_shim_phase.py && \
     phase memory     1800  python benchmarks/memory_analysis.py --big
   else
     echo "probe down $(date +%H:%M:%S)" >> "$LOG"

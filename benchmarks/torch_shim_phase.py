@@ -1,0 +1,106 @@
+"""Torch-shim cost on the real chip (VERDICT r3 item 9).
+
+The torch adapter stages every collective through host numpy onto the
+chip (torch/__init__.py numpy-bridge) — inherent to the CPU-torch-wheel
+environment, but its per-step cost had never been measured on silicon.
+This phase runs the synthetic-benchmark model three ways:
+
+  1. plain SGD, no shim          — pure torch-CPU compute floor
+  2. DistributedOptimizer (chip) — compute + shim staging + chip allreduce
+  3. same but fp16 wire          — compressed staging
+
+The (2)-(1) delta is the shim's real overhead; rows land in
+benchmarks/torch_shim_chip.jsonl for the docs/benchmarks.md table.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import make_recorder, require_tpu, start_stall_watchdog
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+record = make_recorder(os.path.join(_HERE, "torch_shim_chip.jsonl"))
+
+
+def bench(model_fn, wrap, batch=32, warmup=3, iters=8):
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    torch.manual_seed(1234)
+    model = model_fn()
+    optimizer = wrap(model)
+    data = torch.randn(batch, 3, 64, 64)
+    target = torch.randint(0, 10, (batch,))
+
+    def step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(warmup):
+        step()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    return batch / med, med * 1e3
+
+
+def main():
+    import jax
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    start_stall_watchdog(600)
+    require_tpu()
+    hvd.init()
+    dev = jax.devices()[0].device_kind
+    record(event="phase_start", device=dev)
+
+    def model_fn():
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 32, 3, stride=2, padding=1), torch.nn.ReLU(),
+            torch.nn.Conv2d(32, 64, 3, stride=2, padding=1), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+            torch.nn.Linear(64, 10))
+
+    plain = lambda m: torch.optim.SGD(m.parameters(), lr=0.01)  # noqa: E731
+
+    def dist(compression):
+        def wrap(m):
+            return hvd.DistributedOptimizer(
+                torch.optim.SGD(m.parameters(), lr=0.01),
+                named_parameters=m.named_parameters(),
+                compression=compression)
+        return wrap
+
+    rows = {}
+    for tag, wrap in (("plain_sgd", plain),
+                      ("shim_chip", dist(hvd.Compression.none)),
+                      ("shim_chip_fp16", dist(hvd.Compression.fp16))):
+        try:
+            ips, ms = bench(model_fn, wrap)
+            rows[tag] = ms
+            record(event="torch_step", path=tag, img_per_sec=round(ips, 1),
+                   step_ms=round(ms, 2), device=dev)
+        except Exception as e:
+            record(event="error", path=tag,
+                   error=f"{type(e).__name__}: {e}"[:200])
+    if "plain_sgd" in rows and "shim_chip" in rows:
+        record(event="shim_overhead",
+               overhead_ms=round(rows["shim_chip"] - rows["plain_sgd"], 2),
+               device=dev)
+    record(event="phase_done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
