@@ -426,13 +426,11 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
             backward_passes_per_step=backward_passes_per_step,
             average_aggregated_gradients=average_aggregated_gradients)
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
-        if backward_passes_per_step != 1:
-            raise NotImplementedError(
-                "backward_passes_per_step > 1 is supported for Keras "
-                "optimizers only; the tf.compat.v1 path applies every step")
         return _LegacyDistributedOptimizer(
             optimizer, compression, op, gradient_predivide_factor,
-            sparse_as_dense, process_set, name, use_locking)
+            sparse_as_dense, process_set, name, use_locking,
+            backward_passes_per_step=backward_passes_per_step,
+            average_aggregated_gradients=average_aggregated_gradients)
     raise ValueError(
         "unsupported optimizer type for DistributedOptimizer: "
         f"{type(optimizer)}")
@@ -541,26 +539,54 @@ class _DistributedAdasumOptimizer:
 
 class _LegacyDistributedOptimizer(tf.compat.v1.train.Optimizer):
     """tf.compat.v1 path (reference tensorflow/__init__.py:599-663):
-    compute_gradients → allreduce → apply."""
+    compute_gradients → allreduce → apply. With
+    ``backward_passes_per_step > 1``, gradients accumulate locally and
+    the allreduce + apply happen once per window
+    (reference gradient_aggregation.py:16 LocalGradientAggregationHelper;
+    eager redesign in tensorflow/gradient_aggregation.py)."""
 
     def __init__(self, opt, compression, op, gradient_predivide_factor,
-                 sparse_as_dense, process_set, name, use_locking):
+                 sparse_as_dense, process_set, name, use_locking,
+                 backward_passes_per_step: int = 1,
+                 average_aggregated_gradients: bool = False):
         super().__init__(name=name or f"Distributed{type(opt).__name__}",
                          use_locking=use_locking)
         self._opt = opt
         self._tape_cfg = (compression, op, gradient_predivide_factor,
                           sparse_as_dense, process_set)
+        self._agg_helper = None
+        if backward_passes_per_step != 1:
+            from .gradient_aggregation import LocalGradientAggregationHelper
 
-    def compute_gradients(self, *args, **kwargs):
-        gvs = self._opt.compute_gradients(*args, **kwargs)
+            self._agg_helper = LocalGradientAggregationHelper(
+                backward_passes_per_step,
+                allreduce_func=self._allreduce_grads,
+                sparse_as_dense=sparse_as_dense,
+                average_aggregated_gradients=average_aggregated_gradients)
+
+    def _allreduce_grads(self, grads):
         compression, op, predivide, sparse_as_dense, ps = self._tape_cfg
         helper = _DistributedGradientTape(
             None, "", "", compression, False, op, predivide,
             sparse_as_dense, ps)
-        grads = helper._allreduce_grads([g for g, _ in gvs])
+        return helper._allreduce_grads(grads)
+
+    def compute_gradients(self, *args, **kwargs):
+        gvs = self._opt.compute_gradients(*args, **kwargs)
+        if self._agg_helper is not None:
+            grads = self._agg_helper.compute_gradients([g for g, _ in gvs])
+        else:
+            grads = self._allreduce_grads([g for g, _ in gvs])
         return [(g, v) for g, (_, v) in zip(grads, gvs)]
 
     def apply_gradients(self, *args, **kwargs):
+        if self._agg_helper is not None:
+            gs = kwargs.get("global_step")
+            if gs is None and len(args) > 1:  # positional global_step
+                gs = args[1]
+            return self._agg_helper.apply_gradients(
+                lambda: self._opt.apply_gradients(*args, **kwargs),
+                global_step=gs)
         return self._opt.apply_gradients(*args, **kwargs)
 
     def get_slot(self, *args, **kwargs):

@@ -1,0 +1,114 @@
+"""Local gradient aggregation for ``backward_passes_per_step > 1`` on the
+legacy ``tf.compat.v1.train.Optimizer`` path.
+
+Reference: /root/reference/horovod/tensorflow/gradient_aggregation.py:16
+(LocalGradientAggregationHelper) — a graph-mode machine of shadow
+variables, ``tf.cond`` ladders and control dependencies, because v1 graphs
+trace once and replay. This shim executes eagerly (the numpy bridge needs
+concrete tensors), so the redesign is a plain eager accumulator with the
+same semantics:
+
+- gradients accumulate locally for ``backward_passes_per_step`` calls;
+- the cross-process allreduce happens only on the window's last call
+  (optionally dividing by the window length —
+  ``average_aggregated_gradients``);
+- ``apply_gradients`` actually applies only on those boundary calls, and
+  otherwise just advances the tracked global step, exactly like the
+  reference's cond ladder (gradient_aggregation.py:232-268).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import tensorflow as tf
+
+
+class LocalGradientAggregationHelper:
+    def __init__(self, backward_passes_per_step: int,
+                 allreduce_func: Callable[[List], List],
+                 sparse_as_dense: bool = False,
+                 average_aggregated_gradients: bool = False):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = int(backward_passes_per_step)
+        self._allreduce = allreduce_func
+        self.sparse_as_dense = sparse_as_dense
+        self.average_aggregated_gradients = average_aggregated_gradients
+        # counter == 0 means "a window just closed" (or nothing ran yet):
+        # the next compute starts a fresh window, and apply may proceed
+        self.counter = 0
+        self._agg: Optional[list] = None
+
+    def _densify(self, grads: list) -> list:
+        out = []
+        for g in grads:
+            if isinstance(g, tf.IndexedSlices):
+                if not self.sparse_as_dense:
+                    raise ValueError(
+                        "IndexedSlices are not supported when "
+                        "backward_passes_per_step > 1 and sparse_as_dense "
+                        "is False (reference gradient_aggregation.py:83-88)")
+                g = tf.convert_to_tensor(g)
+            out.append(g)
+        return out
+
+    @staticmethod
+    def _require_eager(what: str):
+        """This helper's counter and branching are Python state: traced
+        into a tf.function or a v1 Session graph they would bake in one
+        branch and silently freeze training. The whole numpy-bridge shim
+        is eager-execution; fail loudly rather than train nothing."""
+        if not tf.executing_eagerly():
+            raise NotImplementedError(
+                f"{what} with backward_passes_per_step > 1 runs eagerly "
+                "only (the horovod_tpu TF shim stages tensors through "
+                "numpy); call it outside tf.function / Session graphs")
+
+    def compute_gradients(self, grads: list) -> list:
+        """Accumulate; on the window's last call return the allreduced
+        aggregate (reference compute_gradients,
+        gradient_aggregation.py:175-228). Off-boundary returns the raw
+        local grads — which apply_gradients will skip."""
+        self._require_eager("compute_gradients")
+        grads = self._densify(grads)
+        if self.counter == 0:
+            self._agg = [None if g is None else tf.zeros_like(g)
+                         for g in grads]
+        if len(grads) != len(self._agg):
+            raise ValueError(
+                f"gradient count changed mid-window: {len(self._agg)} -> "
+                f"{len(grads)}")
+        # a slot can be None on the window's first pass and real later
+        # (conditionally-active branches): seed it from the first real grad
+        self._agg = [a if g is None else (g if a is None else a + g)
+                     for a, g in zip(self._agg, grads)]
+        self.counter += 1
+        if self.counter < self.backward_passes_per_step:
+            return grads
+        self.counter = 0
+        reduced = self._allreduce(self._agg)
+        self._agg = None
+        if self.average_aggregated_gradients:
+            reduced = [None if g is None
+                       else g / float(self.backward_passes_per_step)
+                       for g in reduced]
+        return reduced
+
+    @property
+    def at_boundary(self) -> bool:
+        """True right after a window closed: apply_gradients may proceed."""
+        return self.counter == 0
+
+    def apply_gradients(self, apply_closure: Callable,
+                        global_step: Optional[tf.Variable] = None):
+        """Run ``apply_closure`` only on boundary steps; otherwise advance
+        the tracked global step so step-count-driven schedules stay
+        monotonic (reference apply_gradients cond ladder,
+        gradient_aggregation.py:232-268)."""
+        self._require_eager("apply_gradients")
+        if self.at_boundary:
+            return apply_closure()
+        if global_step is not None:
+            global_step.assign_add(1)
+        return tf.no_op()
