@@ -6,10 +6,13 @@ row-groups to each rank so no worker ever materializes the whole dataset.
 
 TPU-native slimming of the same contract:
 
-- ``stage_dataframe`` writes the DataFrame through the ``Store`` as
-  compressed ``.npz`` chunks (dense numpy is the universal currency of the
-  jax/torch/keras estimators here — the Parquet→petastorm→framework-tensor
-  pipeline collapses to one hop). A pyspark DataFrame is consumed via
+- ``stage_dataframe`` writes the DataFrame through the ``Store`` in
+  chunks — **Parquet** chunks (via pyarrow, matching the reference's
+  columnar materialization, util.py:747) when pyarrow is importable, and
+  compressed ``.npz`` otherwise (dense numpy is the universal currency of
+  the jax/torch/keras estimators here). Parquet chunks keep the original
+  column names/types, so the staged store is readable by any Parquet
+  tool, not just this framework. A pyspark DataFrame is consumed via
   ``toLocalIterator()`` — partition at a time, never a whole collect; a
   pandas DataFrame is sliced. Chunks are the row-group analogue.
 - ``StoreDataset`` is the per-rank streaming reader: it owns the chunks
@@ -36,34 +39,94 @@ from .util import _is_spark_df, dataframe_to_numpy
 META_FILE = "meta.json"
 
 
-def _chunk_file(i: int) -> str:
-    return f"chunk_{i:06d}.npz"
+def have_pyarrow() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _chunk_file(i: int, fmt: str = "npz") -> str:
+    return f"chunk_{i:06d}.{'parquet' if fmt == 'parquet' else 'npz'}"
+
+
+def _arrow_table(pdf_part, cols):
+    """pandas chunk → pyarrow Table. Vector-valued cells (pyspark
+    DenseVector, ndarray) are opaque objects to pyarrow — normalize them
+    to plain lists so the chunk is a standard list<float> Parquet column."""
+    import pyarrow as pa
+
+    part = pdf_part[cols].copy()
+    for c in part.columns:
+        if part[c].dtype == object:
+            part[c] = part[c].map(lambda e: np.asarray(e).tolist())
+    return pa.Table.from_pandas(part, preserve_index=False)
 
 
 def stage_dataframe(df, store, path: str, feature_cols: Sequence[str],
                     label_cols: Optional[Sequence[str]] = None,
                     dtype=np.float32, label_dtype=None,
-                    chunk_rows: int = 4096) -> dict:
-    """Write ``df`` through ``store`` as npz chunks under ``path``.
+                    chunk_rows: int = 4096,
+                    format: Optional[str] = None) -> dict:
+    """Write ``df`` through ``store`` as chunks under ``path``.
 
-    Returns (and persists as ``path/meta.json``) the dataset metadata:
-    ``n_rows``, ``n_chunks``, ``chunk_rows`` (per-chunk row counts),
-    feature/label shapes and dtypes. Idempotent restaging is the caller's
-    concern (check ``store.exists(meta_path(path))`` first).
+    ``format``: ``"parquet"`` (columnar chunks via pyarrow — the
+    reference's materialization format, spark/common/util.py:747),
+    ``"npz"`` (compressed dense arrays), or None to pick parquet when
+    pyarrow is importable. Returns (and persists as ``path/meta.json``)
+    the dataset metadata: ``format``, ``n_rows``, ``n_chunks``,
+    ``chunk_rows`` (per-chunk row counts), feature/label shapes and
+    dtypes. Idempotent restaging is the caller's concern (check
+    ``store.exists(meta_path(path))`` first).
     """
+    auto_format = format is None
+    if auto_format:
+        format = "parquet" if have_pyarrow() else "npz"
+    if format not in ("parquet", "npz"):
+        raise ValueError(f"unknown staging format {format!r}")
+    if format == "parquet" and not have_pyarrow():
+        raise ValueError("format='parquet' requires pyarrow")
     state = {"n_rows": 0, "chunks": [], "x_shape": None, "x_dtype": None,
-             "y_shape": None, "y_dtype": None}
+             "y_shape": None, "y_dtype": None, "format": format}
+    cols = list(feature_cols) + list(label_cols or [])
 
     def flush(pdf_part):
+        # shapes/dtypes recorded from the same conversion the reader uses
         x, y = dataframe_to_numpy(pdf_part, feature_cols, label_cols,
                                   dtype=dtype, label_dtype=label_dtype)
         buf = io.BytesIO()
-        arrays = {"x": x}
-        if y is not None:
-            arrays["y"] = y
-        np.savez_compressed(buf, **arrays)
+        if state["format"] == "parquet":
+            import pyarrow.parquet as pq
+
+            try:
+                # original columns, not pre-flattened tensors: the staged
+                # store stays a plain Parquet dataset any tool can read
+                table = _arrow_table(pdf_part, cols)
+            except Exception as e:
+                if not auto_format or state["chunks"]:
+                    # explicitly requested, or some chunks already
+                    # staged (a silent mid-dataset format flip would mix
+                    # formats): surface the conversion problem
+                    raise ValueError(
+                        "parquet staging could not convert a chunk "
+                        f"({type(e).__name__}: {e}); pass format='npz' "
+                        "or normalize the offending column") from e
+                # auto-selected and nothing written yet: npz handles
+                # anything dataframe_to_numpy can
+                state["format"] = "npz"
+        if state["format"] == "parquet":
+            pq.write_table(table, buf)
+        else:
+            arrays = {"x": x}
+            if y is not None:
+                arrays["y"] = y
+            np.savez_compressed(buf, **arrays)
         i = len(state["chunks"])
-        store.write_bytes(f"{path}/{_chunk_file(i)}", buf.getvalue())
+        store.write_bytes(f"{path}/{_chunk_file(i, state['format'])}",
+                          buf.getvalue())
         state["chunks"].append(len(x))
         state["n_rows"] += len(x)
         state["x_shape"], state["x_dtype"] = list(x.shape[1:]), str(x.dtype)
@@ -86,6 +149,7 @@ def stage_dataframe(df, store, path: str, feature_cols: Sequence[str],
             flush(df.iloc[i:i + chunk_rows])
 
     meta = {
+        "format": state["format"],
         "n_rows": state["n_rows"],
         "n_chunks": len(state["chunks"]),
         "chunk_rows": state["chunks"],
@@ -170,12 +234,26 @@ class StoreDataset:
         return min(self.shard_batches(batch_size, s)
                    for s in range(self.num_shards))
 
+    def _decode_chunk(self, blob: bytes
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if self.meta.get("format", "npz") == "parquet":
+            import pyarrow.parquet as pq
+
+            pdf = pq.read_table(io.BytesIO(blob)).to_pandas()
+            return dataframe_to_numpy(
+                pdf, self.meta["feature_cols"],
+                self.meta["label_cols"] or None,
+                dtype=np.dtype(self.meta["x_dtype"]),
+                label_dtype=(np.dtype(self.meta["y_dtype"])
+                             if self.meta.get("y_dtype") else None))
+        z = np.load(io.BytesIO(blob), allow_pickle=False)
+        return z["x"], (z["y"] if "y" in z.files else None)
+
     def iter_chunks(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        fmt = self.meta.get("format", "npz")
         for ci in self._chunks:
-            blob = self.store.read_bytes(f"{self.path}/{_chunk_file(ci)}")
-            z = np.load(io.BytesIO(blob), allow_pickle=False)
-            x = z["x"]
-            y = z["y"] if "y" in z.files else None
+            blob = self.store.read_bytes(f"{self.path}/{_chunk_file(ci, fmt)}")
+            x, y = self._decode_chunk(blob)
             self.max_rows_resident = max(self.max_rows_resident, len(x))
             if self.row_sharded:
                 x = x[self.shard_id::self.num_shards]
