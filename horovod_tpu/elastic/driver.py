@@ -142,6 +142,10 @@ class ElasticDriver:
             except RuntimeError as e:
                 LOG.error("elastic: %s", e)
                 return 1
+            # the round's full assignment, visible to per-slot env
+            # factories that need cross-slot facts (who is rank 0, which
+            # hosts are remote) — see make_base_env_fn
+            self.current_slots = slots
             self.registry.reset()
             workers: dict[int, tuple[SlotInfo, WorkerHandle]] = {}
             for slot in slots:
@@ -215,23 +219,46 @@ class ElasticDriver:
 
 
 def make_base_env_fn(driver: ElasticDriver, extra: dict,
-                     hostname_override: Optional[str] = None):
+                     hostname_override: Optional[str] = None,
+                     network_interface: Optional[str] = None):
     """Per-slot env factory shared by the CLI elastic path and the Ray
     elastic executor. One coordinator address per round: every slot of a
     round must share it (jax.distributed world bootstrap), and each round
     needs a fresh port — the previous incarnation's coordinator may still
-    be tearing down."""
+    be tearing down.
+
+    Addressing per round (same route-probe redesign as the static
+    launcher, runner/network.py): the rendezvous address is the driver
+    address routable from the round's remote hosts (127.0.0.1 when all
+    slots are local; ``network_interface`` pins the NIC); the
+    jax.distributed coordinator binds on rank 0's host, so its address is
+    that host — or the driver address when rank 0 is local."""
     from ..common import env as env_schema
     from ..runner.launch import _free_port, slot_env
+    from ..runner.network import is_local_host, pick_coordinator_address
 
-    coord_by_epoch: dict[int, str] = {}
+    by_epoch: dict[int, tuple[str, str]] = {}
 
     def base_env(slot: SlotInfo) -> dict:
         ep = driver._epoch
-        if ep not in coord_by_epoch:
-            coord_by_epoch[ep] = f"127.0.0.1:{_free_port()}"
-        e = slot_env(slot, "127.0.0.1", driver.rendezvous.port,
-                     coord_by_epoch[ep], extra)
+        if ep not in by_epoch:
+            slots = getattr(driver, "current_slots", None) or [slot]
+            remote = sorted({s.hostname for s in slots
+                             if not is_local_host(s.hostname)})
+            if remote:
+                addr, _ = pick_coordinator_address(
+                    remote, iface_override=network_interface)
+            else:
+                addr = "127.0.0.1"
+            s0 = next((s for s in slots if s.rank == 0), slot)
+            coord_host = (addr if is_local_host(s0.hostname)
+                          else s0.hostname)
+            # _free_port probes on the driver host — best-effort for a
+            # remote rank 0 (same limitation as the Ray engine's
+            # free_port_on fallback)
+            by_epoch[ep] = (addr, f"{coord_host}:{_free_port()}")
+        addr, coordinator = by_epoch[ep]
+        e = slot_env(slot, addr, driver.rendezvous.port, coordinator, extra)
         if hostname_override is not None:
             e[env_schema.HOROVOD_HOSTNAME] = hostname_override
         return e
@@ -242,7 +269,6 @@ def make_base_env_fn(driver: ElasticDriver, extra: dict,
 def run_elastic(command: list[str], args) -> int:
     """CLI entry (reference launch.py:621 _run_elastic →
     gloo_run_elastic)."""
-    import socket
     import sys
     import tempfile
     import uuid
@@ -272,7 +298,9 @@ def run_elastic(command: list[str], args) -> int:
         os.path.join(tempfile.gettempdir(),
                      f"hvd_elastic_{uuid.uuid4().hex[:8]}.pkl"))
 
-    base_env = make_base_env_fn(driver, extra)
+    base_env = make_base_env_fn(
+        driver, extra,
+        network_interface=getattr(args, "network_interface", None))
 
     out_dir = getattr(args, "output_filename", None)
     if out_dir:
@@ -280,8 +308,9 @@ def run_elastic(command: list[str], args) -> int:
     teed_ranks: set[int] = set()
 
     def create_worker(slot: SlotInfo, env: dict) -> WorkerHandle:
-        local = slot.hostname in (socket.gethostname(), "localhost",
-                                  "127.0.0.1")
+        from ..runner.network import is_local_host
+
+        local = is_local_host(slot.hostname)
         if local:
             cmd = command
         else:

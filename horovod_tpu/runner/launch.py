@@ -7,9 +7,11 @@ SSH fan-out :226-271), mpi_run.py. TPU-native differences:
 - rendezvous = our HTTP KV store + ``jax.distributed.initialize`` (the
   coordination service replaces MPI/Gloo bootstrap);
 - one worker process per host VM drives all local chips (slots default 1);
-- no NIC negotiation protocol: the coordinator address is injected by the
-  launcher (TPU pods have a flat data-center network; ICI topology is
-  discovered by the TPU runtime itself, not the launcher).
+- NIC discovery is a launcher-side route probe (runner/network.py) instead
+  of the reference's SSH'd task-service intersection protocol — ICI
+  topology is discovered by the TPU runtime itself, the launcher only has
+  to pick the address workers dial for rendezvous/coordinator traffic
+  (--network-interface overrides).
 
 Usage:
     hvdrun -np 2 python train.py
@@ -143,7 +145,8 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
                  ssh_identity_file: Optional[str] = None,
                  extra_env: Optional[dict] = None,
                  verbose: bool = False,
-                 output_filename: Optional[str] = None) -> int:
+                 output_filename: Optional[str] = None,
+                 network_interface: Optional[str] = None) -> int:
     """Spawn one worker per slot (local exec or SSH for remote hosts),
     stream rank-prefixed output, kill the job on first failure
     (reference gloo_run.py:252-271). ``output_filename`` additionally
@@ -159,9 +162,19 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
     get_or_mint_env_secret()
     rendezvous = RendezvousServer()
     rendezvous.start()
-    this_host = socket.gethostname()
-    addr = "127.0.0.1" if all(s.hostname in (this_host, "localhost", "127.0.0.1")
-                              for s in slots) else socket.getfqdn()
+    from .network import is_local_host, pick_coordinator_address
+
+    remote = sorted({s.hostname for s in slots
+                     if not is_local_host(s.hostname)})
+    if not remote:
+        addr = "127.0.0.1"
+    else:
+        # probe which local address routes to the workers (reference
+        # get_common_interfaces, driver_service.py:218; redesigned as a
+        # launcher-side route lookup — see runner/network.py)
+        addr, _ = pick_coordinator_address(
+            remote, iface_override=network_interface or os.environ.get(
+                env_schema.HOROVOD_GLOO_IFACE))
     coordinator = f"{addr}:{_free_port()}"
 
     procs: list[subprocess.Popen] = []
@@ -169,7 +182,7 @@ def launch_slots(command: list[str], slots: list[SlotInfo], *,
     try:
         for slot in slots:
             e = slot_env(slot, addr, rendezvous.port, coordinator, extra_env)
-            local = slot.hostname in (this_host, "localhost", "127.0.0.1")
+            local = is_local_host(slot.hostname)
             if local:
                 p = subprocess.Popen(command, env=e, stdout=subprocess.PIPE,
                                      stderr=subprocess.PIPE)
@@ -252,6 +265,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="directory for per-rank output files "
                         "rank.<r>.{out,err} (reference horovodrun "
                         "--output-filename); console streaming continues")
+    p.add_argument("--network-interface", default=None,
+                   help="NIC whose address workers dial for rendezvous/"
+                        "coordinator traffic (reference horovodrun "
+                        "--network-interface); default: probe the route "
+                        "to each worker host")
     p.add_argument("--log-level", default=None)
     # elastic
     p.add_argument("--min-np", type=int, default=None)
@@ -394,7 +412,8 @@ def run_commandline(argv=None) -> int:
     return launch_slots(command, slots, ssh_port=args.ssh_port,
                         ssh_identity_file=args.ssh_identity_file,
                         extra_env=_knob_env(args), verbose=args.verbose,
-                        output_filename=args.output_filename)
+                        output_filename=args.output_filename,
+                        network_interface=args.network_interface)
 
 
 def main():

@@ -1,0 +1,106 @@
+"""Coordinator-address selection for multi-host launches.
+
+Reference: /root/reference/horovod/runner/driver/driver_service.py:162-258
+(``_driver_fn`` / ``get_common_interfaces``) — there the launcher SSHes a
+task service onto every host, each task registers its NICs, and the
+driver computes the intersection of mutually routable interfaces. The
+TPU redesign is launcher-side and connectionless: for every remote
+worker host, a UDP ``connect`` (no packet sent) asks the kernel's
+routing table which local source address would reach it —
+``getsockname`` after connect is the route lookup. One address reaching
+every worker is the coordinator address; disagreement (multi-NIC,
+split-horizon routes) triggers a warning naming the candidates and the
+``--network-interface`` override (reference launch.py:275 ``--nics``).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+from typing import Optional, Sequence, Tuple
+
+LOG = logging.getLogger("horovod_tpu")
+
+LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
+
+
+def is_local_host(hostname: str) -> bool:
+    return hostname in LOCAL_NAMES or hostname == socket.gethostname()
+
+
+def interface_address(ifname: str) -> str:
+    """IPv4 address bound to ``ifname`` (Linux SIOCGIFADDR ioctl — the
+    stdlib has no interface->address map)."""
+    import fcntl
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        packed = struct.pack("256s", ifname[:15].encode())
+        return socket.inet_ntoa(
+            fcntl.ioctl(s.fileno(), 0x8915, packed)[20:24])  # SIOCGIFADDR
+    except OSError as e:
+        raise ValueError(
+            f"--network-interface {ifname!r}: cannot read an IPv4 address "
+            f"({e.strerror or e}); check the interface name with `ip -4 "
+            "addr`") from e
+    finally:
+        s.close()
+
+
+def source_address_for(host: str, port: int = 9) -> Optional[str]:
+    """The local source address the kernel would route toward ``host``
+    (UDP connect performs the route lookup without sending anything)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((host, port))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return None
+
+
+def pick_coordinator_address(
+        remote_hosts: Sequence[str],
+        iface_override: Optional[str] = None) -> Tuple[str, bool]:
+    """The address workers should dial for the rendezvous/coordinator.
+
+    Returns ``(address, ambiguous)``; ``ambiguous`` is True when remote
+    hosts route through different local addresses and the majority pick
+    may be wrong for some of them (the warning advises the override).
+    """
+    if iface_override:
+        addr = interface_address(iface_override)
+        LOG.info("coordinator address %s from --network-interface %s",
+                 addr, iface_override)
+        return addr, False
+    votes: dict[str, list[str]] = {}
+    unresolved = []
+    for h in remote_hosts:
+        src = source_address_for(h)
+        if src is None:
+            unresolved.append(h)
+            continue
+        votes.setdefault(src, []).append(h)
+    if not votes:
+        # nothing resolved (names not in DNS yet, say): last resort is the
+        # historical behavior — the launcher's FQDN
+        LOG.warning(
+            "could not resolve a route to any of %s; falling back to this "
+            "host's FQDN for the coordinator address (override with "
+            "--network-interface)", list(remote_hosts))
+        return socket.getfqdn(), True
+    best = max(votes, key=lambda a: len(votes[a]))
+    ambiguous = len(votes) > 1 or bool(unresolved)
+    if ambiguous:
+        LOG.warning(
+            "workers route through different local addresses (%s%s); using "
+            "%s — if some workers cannot reach it, pass "
+            "--network-interface <ifname> to pin the coordinator NIC "
+            "(reference get_common_interfaces, driver_service.py:218)",
+            {a: hs for a, hs in votes.items()},
+            f"; unresolved: {unresolved}" if unresolved else "",
+            best)
+    return best, ambiguous

@@ -197,3 +197,85 @@ def test_output_filename_per_rank_files(tmp_path):
                           sys.executable, str(script)])
     assert rc == 0
     assert (outdir / "rank.0.out").read_text().count("hello-from") == 1
+
+
+# --- coordinator-address probing (VERDICT r3 #7) ---------------------------
+
+def test_pick_coordinator_address_unanimous(monkeypatch):
+    """All workers route through one local address: that's the pick, no
+    warning (reference get_common_interfaces, driver_service.py:218)."""
+    from horovod_tpu.runner import network
+
+    monkeypatch.setattr(network, "source_address_for",
+                        lambda h, port=9: "10.0.0.5")
+    addr, ambiguous = network.pick_coordinator_address(["a", "b", "c"])
+    assert addr == "10.0.0.5" and not ambiguous
+
+
+def test_pick_coordinator_address_ambiguous_majority(monkeypatch, caplog):
+    """Split routes: majority wins, warning names candidates and the
+    --network-interface override."""
+    import logging
+
+    from horovod_tpu.runner import network
+
+    routes = {"a": "10.0.0.5", "b": "10.0.0.5", "c": "192.168.1.9"}
+    monkeypatch.setattr(network, "source_address_for",
+                        lambda h, port=9: routes[h])
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        addr, ambiguous = network.pick_coordinator_address(["a", "b", "c"])
+    assert addr == "10.0.0.5" and ambiguous
+    assert "--network-interface" in caplog.text
+
+
+def test_pick_coordinator_address_override(monkeypatch):
+    """--network-interface pins the NIC; no probing happens."""
+    from horovod_tpu.runner import network
+
+    monkeypatch.setattr(network, "interface_address",
+                        lambda ifname: {"eth7": "172.16.0.2"}[ifname])
+    monkeypatch.setattr(network, "source_address_for",
+                        lambda h, port=9: (_ for _ in ()).throw(
+                            AssertionError("must not probe")))
+    addr, ambiguous = network.pick_coordinator_address(
+        ["a"], iface_override="eth7")
+    assert addr == "172.16.0.2" and not ambiguous
+
+
+def test_pick_coordinator_address_unresolvable(monkeypatch, caplog):
+    """No route to any worker: FQDN fallback with a warning (historical
+    behavior, now explicit)."""
+    import logging
+
+    from horovod_tpu.runner import network
+
+    monkeypatch.setattr(network, "source_address_for", lambda h, port=9: None)
+    monkeypatch.setattr(network.socket, "getfqdn", lambda: "driver.example")
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        addr, ambiguous = network.pick_coordinator_address(["ghost"])
+    assert addr == "driver.example" and ambiguous
+
+
+def test_localhost_launch_never_probes(monkeypatch, tmp_path):
+    """-H localhost keeps the 127.0.0.1 coordinator: probing must not
+    run for purely local jobs."""
+    from horovod_tpu.runner import launch, network
+
+    monkeypatch.setattr(network, "pick_coordinator_address",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("must not probe locally")))
+    script = tmp_path / "w.py"
+    script.write_text("import os\n"
+                      "assert os.environ['HOROVOD_TPU_COORDINATOR']"
+                      ".startswith('127.0.0.1:')\n"
+                      "print('local ok')\n")
+    rc = launch.run_commandline(["-np", "1", sys.executable, str(script)])
+    assert rc == 0
+
+
+def test_source_address_for_loopback_real():
+    """Un-mocked probe against the loopback: the kernel routes 127.0.0.1
+    via 127.0.0.1."""
+    from horovod_tpu.runner.network import source_address_for
+
+    assert source_address_for("127.0.0.1") == "127.0.0.1"
