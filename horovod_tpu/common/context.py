@@ -381,6 +381,14 @@ def local_size() -> int:
 
 
 def local_rank() -> int:
+    """This process's rank among processes on the same host.
+
+    Standalone (no launcher env) this is 0: ONE process drives ALL local
+    chips here, unlike the reference's process-per-GPU model. A ported
+    script that maps ``local_rank()`` to a device index
+    (``torch.cuda.set_device(hvd.local_rank())``-style) would silently
+    address only device 0 — iterate ``jax.local_devices()`` or shard over
+    the process set's mesh instead (see docs/running.md)."""
     ctx = _require_init()
     v = os.environ.get(env_schema.HOROVOD_LOCAL_RANK)
     if v is not None:

@@ -468,9 +468,10 @@ def test_parquet_staging_sanitizes_and_falls_back(tmp_path, monkeypatch):
         "y": rng.randint(0, 3, n)})
     store = FilesystemStore(str(tmp_path / "st"))
 
-    meta = stage_dataframe(df, store, "vec", ["f"], ["y"], chunk_rows=32)
+    vec = store.get_train_data_path(0)
+    meta = stage_dataframe(df, store, vec, ["f"], ["y"], chunk_rows=32)
     assert meta["format"] == "parquet"  # sanitized into list columns
-    ds = StoreDataset(store, "vec")
+    ds = StoreDataset(store, vec)
     rows = sum(len(xb) for xb, _ in ds.batches(16))
     assert rows == n
 
@@ -479,11 +480,12 @@ def test_parquet_staging_sanitizes_and_falls_back(tmp_path, monkeypatch):
         raise pa.lib.ArrowInvalid("nope")
 
     monkeypatch.setattr(datamodule, "_arrow_table", boom)
-    meta = stage_dataframe(df, store, "fb", ["f"], ["y"], chunk_rows=32)
+    fb = store.get_train_data_path(1)
+    meta = stage_dataframe(df, store, fb, ["f"], ["y"], chunk_rows=32)
     assert meta["format"] == "npz"
-    ds = StoreDataset(store, "fb")
+    ds = StoreDataset(store, fb)
     assert sum(len(xb) for xb, _ in ds.batches(16)) == n
     # ...but an explicit parquet request surfaces the problem
     with pytest.raises(ValueError, match="parquet staging could not"):
-        stage_dataframe(df, store, "explicit", ["f"], ["y"],
-                        chunk_rows=32, format="parquet")
+        stage_dataframe(df, store, store.get_train_data_path(2),
+                        ["f"], ["y"], chunk_rows=32, format="parquet")
