@@ -456,6 +456,21 @@ def _eager_broadcast(x, root_rank: int, ps: ProcessSet):
     return _cached(key, build)(g)
 
 
+def _cached_slice(x, start: int, stop: int):
+    """Compiled dim-0 slice with static bounds: eager ``x[a:b]`` stages
+    its scalar start index host-to-device, which a transfer guard on the
+    device-resident paths forbids."""
+    rest = tuple(int(d) for d in x.shape[1:])
+    key = ("slice0", start, stop, int(x.shape[0]), rest, str(x.dtype))
+
+    def build():
+        starts = (start,) + (0,) * len(rest)
+        limits = (stop,) + rest
+        return jax.jit(lambda v: lax.slice(v, starts, limits))
+
+    return _cached(key, build)(x)
+
+
 def _device_zeros(shape, dtype, dev):
     """Zeros materialized on ``dev`` by a cached compiled program — no
     host constant, so transfer guards never fire."""
@@ -545,15 +560,30 @@ def _eager_alltoall_dense(xl, split_mat: np.ndarray, ps: ProcessSet):
     exchange)."""
     nproc, me = ps.cross_size, ps.cross_rank
     maxs = int(split_mat.max())
-    xl = _to_local_np(xl)
+    rest = tuple(int(d) for d in xl.shape[1:])
     splits = split_mat[me]
     recv_splits = split_mat[:, me]
-    send = np.zeros((nproc, maxs) + xl.shape[1:], xl.dtype)
-    offs = np.concatenate([[0], np.cumsum(splits)])
-    for p in range(nproc):
-        send[p, : splits[p]] = xl[offs[p]: offs[p + 1]]
-    _LAST_ALLTOALL_STAGING.update(staged=send.nbytes, payload=xl.nbytes)
-    key = ("alltoall", ps.name, send.shape, str(send.dtype))
+    even = int(split_mat.min()) == maxs
+    itemsize = np.dtype(_np_dtype(xl)).itemsize * int(np.prod(rest))
+    if even and isinstance(xl, jax.Array):
+        # even splits + device input: reshape is a device op and the
+        # whole exchange stays transfer-guard clean
+        skey = ("a2a_send_even", nproc, maxs, rest, str(xl.dtype))
+
+        def build_send():
+            return jax.jit(lambda x: x.reshape((nproc, maxs) + rest))
+
+        send = _cached(skey, build_send)(xl)
+    else:
+        xl = _to_local_np(xl)
+        send = np.zeros((nproc, maxs) + xl.shape[1:], xl.dtype)
+        offs = np.concatenate([[0], np.cumsum(splits)])
+        for p in range(nproc):
+            send[p, : splits[p]] = xl[offs[p]: offs[p + 1]]
+    _LAST_ALLTOALL_STAGING.update(
+        staged=nproc * maxs * itemsize,
+        payload=int(split_mat[me].sum()) * itemsize)
+    key = ("alltoall", ps.name, tuple(send.shape), str(send.dtype))
 
     def build():
         def f(g):  # g: [src, dest, maxs, ...] -> [dest, src, maxs, ...]
@@ -564,6 +594,16 @@ def _eager_alltoall_dense(xl, split_mat: np.ndarray, ps: ProcessSet):
 
     g = _global_row_array(ps, send)
     res = _cached(key, build)(g)
+    if even and isinstance(send, jax.Array):
+        row = res.addressable_data(0)  # [1, src, maxs, ...] on device
+        okey = ("a2a_recv_even", nproc, maxs, rest, str(send.dtype))
+
+        def build_out():
+            return jax.jit(
+                lambda rw: rw[0].reshape((nproc * maxs,) + rest))
+
+        return (_cached(okey, build_out)(row),
+                jax.device_put(recv_splits))
     col = np.asarray(res.addressable_data(0))[0]  # [src, maxs, ...]
     parts = [col[p, : recv_splits[p]] for p in range(nproc)]
     return (jnp.asarray(np.concatenate(parts, axis=0)),
@@ -621,7 +661,7 @@ def _eager_alltoall_ragged(xl, split_mat: np.ndarray, ps: ProcessSet):
         for d in range(nproc):
             seg = xl_np[offs[d]: offs[d + 1]]
             mine[boffs[me][d]: boffs[me][d] + seg.shape[0]] = seg
-    itemsize = np.dtype(dtype).itemsize * max(int(np.prod(rest)), 1)
+    itemsize = np.dtype(dtype).itemsize * int(np.prod(rest))
     _LAST_ALLTOALL_STAGING.update(
         staged=totals[me] * itemsize,
         payload=int(xl.shape[0]) * itemsize)
@@ -633,15 +673,7 @@ def _eager_alltoall_ragged(xl, split_mat: np.ndarray, ps: ProcessSet):
         # Same transfer-guard rules as the main path: compiled slice for
         # a device input, explicit device_put for the host-derived splits
         if device_in:
-            skey = ("a2a_self", int(offs[me]), int(offs[me + 1]),
-                    int(xl.shape[0]), rest, str(dtype))
-
-            def build_self():
-                starts = (int(offs[me]),) + (0,) * len(rest)
-                limits = (int(offs[me + 1]),) + rest
-                return jax.jit(lambda x: lax.slice(x, starts, limits))
-
-            return (_cached(skey, build_self)(xl),
+            return (_cached_slice(xl, int(offs[me]), int(offs[me + 1])),
                     jax.device_put(recv_splits))
         return (jnp.asarray(xl_np[offs[me]: offs[me + 1]]),
                 jnp.asarray(recv_splits))
@@ -744,16 +776,16 @@ def _eager_alltoall_ragged(xl, split_mat: np.ndarray, ps: ProcessSet):
 
 
 def _eager_reducescatter(x, op, ps: ProcessSet):
-    xl = _to_local_np(x)
+    xl = _to_local(x)
     nproc = ps.cross_size
     if xl.shape[0] % max(nproc, 1):
         raise ValueError("first dim must be divisible by the number of processes")
     if nproc == 1:
         return jnp.asarray(xl)
-    red = _eager_allreduce(x, op, ps, 1.0, 1.0)
-    chunk = xl.shape[0] // nproc
+    red = _eager_allreduce(xl, op, ps, 1.0, 1.0)
+    chunk = int(xl.shape[0]) // nproc
     me = ps.cross_rank
-    return red[me * chunk : (me + 1) * chunk]
+    return _cached_slice(red, me * chunk, (me + 1) * chunk)
 
 
 # ===========================================================================

@@ -488,6 +488,21 @@ RAGGED_DEVICE_WORKER = textwrap.dedent("""
     else:
         assert list(rs) == [2, 1] and list(out) == [1, 2, 13], (rs, out)
 
+    # --- even-split alltoall + reducescatter, device-resident ----------
+    xe = jnp.arange(4, dtype=jnp.float32) + 10 * r
+    jax.block_until_ready(xe)
+    with jax.transfer_guard("disallow"):
+        oute, rse = hvd.alltoall(xe)
+        outr = hvd.reducescatter(xe, op=hvd.Sum)
+        jax.block_until_ready((oute, outr))
+    oute = np.asarray(oute)
+    want = ([0, 1, 10, 11] if r == 0 else [2, 3, 12, 13])
+    assert list(oute) == want, (r, oute)
+    assert list(np.asarray(rse)) == [2, 2]
+    outr = np.asarray(outr)
+    wantr = ([10, 12] if r == 0 else [14, 16])
+    assert list(outr) == wantr, (r, outr)
+
     # --- zero-sender device rank in a ragged exchange ------------------
     xs = (jnp.zeros((0, 2), jnp.float32) if r == 0
           else jnp.arange(8.0, dtype=jnp.float32).reshape(4, 2))
