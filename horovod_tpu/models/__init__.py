@@ -1,4 +1,6 @@
+from .inception import InceptionV3  # noqa: F401
 from .mlp import MLP, MnistConvNet  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .vgg import VGG, VGG16, VGG19  # noqa: F401
 from .vit import ViT, ViT_B16, ViT_L16, ViT_S16  # noqa: F401
 from . import transformer  # noqa: F401

@@ -1,0 +1,168 @@
+"""Inception V3 (Szegedy et al., arXiv:1512.00567) — the reference's
+first headline benchmark model (docs/benchmarks.rst:11: ~90% scaling at
+512 GPUs alongside ResNet-101).
+
+TPU-first: NHWC, bfloat16 compute with float32 batch-norm statistics and
+logits, static shapes; the factorized 1x7/7x1 convolutions are plain MXU
+matmuls after XLA's im2col. The auxiliary classifier head is omitted
+(the reference's synthetic benchmark never trains it; add-back would be
+one more branch on the mixed-7b tap).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    ch: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.ch, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x).astype(self.dtype)
+
+
+def _branch(x, specs, train, dtype):
+    for ch, kernel, strides, padding in specs:
+        x = ConvBN(ch, kernel, strides, padding, dtype=dtype)(x, train)
+    return x
+
+
+class InceptionA(nn.Module):
+    pool_ch: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = _branch(x, [(64, (1, 1), (1, 1), "SAME")], train, d)
+        b2 = _branch(x, [(48, (1, 1), (1, 1), "SAME"),
+                         (64, (5, 5), (1, 1), "SAME")], train, d)
+        b3 = _branch(x, [(64, (1, 1), (1, 1), "SAME"),
+                         (96, (3, 3), (1, 1), "SAME"),
+                         (96, (3, 3), (1, 1), "SAME")], train, d)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _branch(b4, [(self.pool_ch, (1, 1), (1, 1), "SAME")], train, d)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = _branch(x, [(384, (3, 3), (2, 2), "VALID")], train, d)
+        b2 = _branch(x, [(64, (1, 1), (1, 1), "SAME"),
+                         (96, (3, 3), (1, 1), "SAME"),
+                         (96, (3, 3), (2, 2), "VALID")], train, d)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    c7: int  # 7x7-factorized branch width
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d, c7 = self.dtype, self.c7
+        b1 = _branch(x, [(192, (1, 1), (1, 1), "SAME")], train, d)
+        b2 = _branch(x, [(c7, (1, 1), (1, 1), "SAME"),
+                         (c7, (1, 7), (1, 1), "SAME"),
+                         (192, (7, 1), (1, 1), "SAME")], train, d)
+        b3 = _branch(x, [(c7, (1, 1), (1, 1), "SAME"),
+                         (c7, (7, 1), (1, 1), "SAME"),
+                         (c7, (1, 7), (1, 1), "SAME"),
+                         (c7, (7, 1), (1, 1), "SAME"),
+                         (192, (1, 7), (1, 1), "SAME")], train, d)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _branch(b4, [(192, (1, 1), (1, 1), "SAME")], train, d)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = _branch(x, [(192, (1, 1), (1, 1), "SAME"),
+                         (320, (3, 3), (2, 2), "VALID")], train, d)
+        b2 = _branch(x, [(192, (1, 1), (1, 1), "SAME"),
+                         (192, (1, 7), (1, 1), "SAME"),
+                         (192, (7, 1), (1, 1), "SAME"),
+                         (192, (3, 3), (2, 2), "VALID")], train, d)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = _branch(x, [(320, (1, 1), (1, 1), "SAME")], train, d)
+        b2 = _branch(x, [(384, (1, 1), (1, 1), "SAME")], train, d)
+        b2 = jnp.concatenate([
+            _branch(b2, [(384, (1, 3), (1, 1), "SAME")], train, d),
+            _branch(b2, [(384, (3, 1), (1, 1), "SAME")], train, d)],
+            axis=-1)
+        b3 = _branch(x, [(448, (1, 1), (1, 1), "SAME"),
+                         (384, (3, 3), (1, 1), "SAME")], train, d)
+        b3 = jnp.concatenate([
+            _branch(b3, [(384, (1, 3), (1, 1), "SAME")], train, d),
+            _branch(b3, [(384, (3, 1), (1, 1), "SAME")], train, d)],
+            axis=-1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _branch(b4, [(192, (1, 1), (1, 1), "SAME")], train, d)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        x = x.astype(d)
+        # stem (299x299 -> 35x35x192)
+        x = ConvBN(32, (3, 3), (2, 2), "VALID", dtype=d)(x, train)
+        x = ConvBN(32, (3, 3), (1, 1), "VALID", dtype=d)(x, train)
+        x = ConvBN(64, (3, 3), (1, 1), "SAME", dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = ConvBN(80, (1, 1), (1, 1), "VALID", dtype=d)(x, train)
+        x = ConvBN(192, (3, 3), (1, 1), "VALID", dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # mixed 5b-5d
+        for pool_ch in (32, 64, 64):
+            x = InceptionA(pool_ch, dtype=d)(x, train)
+        x = ReductionA(dtype=d)(x, train)          # -> 17x17x768
+        for c7 in (128, 160, 160, 192):
+            x = InceptionB(c7, dtype=d)(x, train)
+        x = ReductionB(dtype=d)(x, train)          # -> 8x8x1280
+        for _ in range(2):
+            x = InceptionC(dtype=d)(x, train)      # -> 8x8x2048
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+# fwd compute per image at 299x299, MAC-counted (same convention as
+# bench.py's ResNet-50 4.09e9 and vgg.py — cross-model numbers compare)
+INCEPTION3_FWD_FLOP_PER_IMG = 5.7e9

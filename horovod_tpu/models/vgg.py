@@ -1,0 +1,59 @@
+"""VGG (Simonyan & Zisserman) — the reference's third headline benchmark
+model (docs/benchmarks.rst:13: VGG-16 at 512 GPUs, ~68% scaling — its
+dense 4096-wide classifier makes it the communication-heavy stressor of
+the three).
+
+TPU-first: NHWC, bfloat16 compute with float32 classifier logits, static
+shapes throughout; the 3x3 conv stacks map straight onto the MXU. The
+classic architecture carries no batch norm; dropout gates on ``train``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# conv plan: (convs per stage, channels)
+_VGG16_STAGES: Sequence[tuple[int, int]] = (
+    (2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+_VGG19_STAGES: Sequence[tuple[int, int]] = (
+    (2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+class VGG(nn.Module):
+    stages: Sequence[tuple[int, int]]
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for n_convs, ch in self.stages:
+            for _ in range(n_convs):
+                x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for width in (4096, 4096):
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # f32 logits: softmax/xent stability costs nothing on the VPU
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(stages=_VGG16_STAGES, **kw)
+
+
+def VGG19(**kw) -> VGG:
+    return VGG(stages=_VGG19_STAGES, **kw)
+
+
+# fwd compute per image at 224x224, MAC-counted (the convention of the
+# commonly-quoted model costs and of bench.py's ResNet-50 4.09e9):
+# convs ~15.3e9 MACs + classifier ~0.12e9
+VGG16_FWD_FLOP_PER_IMG = 15.5e9

@@ -574,14 +574,19 @@ def _eager_alltoall_dense(xl, split_mat: np.ndarray, ps: ProcessSet):
             return jax.jit(lambda x: x.reshape((nproc, maxs) + rest))
 
         send = _cached(skey, build_send)(xl)
+        host_staged = 0  # on-device reshape: nothing touches the host
     else:
-        xl = _to_local_np(xl)
+        # device_get is an EXPLICIT transfer: a device-resident input that
+        # lands here (uneven splits past the per-edge fallback threshold)
+        # degrades to host staging without tripping a transfer guard
+        xl = np.asarray(jax.device_get(xl))
         send = np.zeros((nproc, maxs) + xl.shape[1:], xl.dtype)
         offs = np.concatenate([[0], np.cumsum(splits)])
         for p in range(nproc):
             send[p, : splits[p]] = xl[offs[p]: offs[p + 1]]
+        host_staged = send.nbytes
     _LAST_ALLTOALL_STAGING.update(
-        staged=nproc * maxs * itemsize,
+        staged=host_staged,
         payload=int(split_mat[me].sum()) * itemsize)
     key = ("alltoall", ps.name, tuple(send.shape), str(send.dtype))
 

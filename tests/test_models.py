@@ -246,3 +246,74 @@ def test_resnet_im2col_full_model_matches_native():
     for a, b in zip(jax.tree.leaves(g_n), jax.tree.leaves(g_i)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_vgg16_forward_and_train_step():
+    """VGG-16 — the reference's communication-heavy headline model
+    (docs/benchmarks.rst:13). Small spatial input keeps the CPU test
+    fast; the dense classifier still dominates the parameter count."""
+    from horovod_tpu.models import VGG16
+
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, (2,)))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10) and out.dtype == jnp.float32
+
+    opt = optax.sgd(1e-2)
+    params = variables["params"]
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p}, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(2)})
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits)
+                                     * jax.nn.one_hot(y, 10), -1))
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(g, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    params, state, l0 = step(params, state)
+    for _ in range(5):
+        params, state, loss = step(params, state)
+    assert np.isfinite(float(loss)) and float(loss) < float(l0)
+
+
+def test_inception_v3_forward_and_grad():
+    """Inception V3 — the reference's first headline model
+    (docs/benchmarks.rst:11). 299x299 is the canonical input; a single
+    forward + grad on batch 1 keeps CPU time bounded while covering
+    every mixed/reduction block."""
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 299, 299, 3),
+                    jnp.float32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True)
+    out, upd = model.apply(variables, x, train=True,
+                           mutable=["batch_stats"],
+                           rngs={"dropout": jax.random.PRNGKey(2)})
+    assert out.shape == (1, 10) and out.dtype == jnp.float32
+    assert "batch_stats" in upd
+
+    def loss_fn(p):
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+            rngs={"dropout": jax.random.PRNGKey(2)})
+        return jnp.mean(logits ** 2)
+
+    g = jax.jit(jax.grad(loss_fn))(variables["params"])
+    leaves = jax.tree.leaves(g)
+    assert leaves and all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    # eval path uses running stats, no dropout
+    out_eval = model.apply(variables, x, train=False)
+    assert out_eval.shape == (1, 10)
