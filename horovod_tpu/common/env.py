@@ -18,6 +18,8 @@ import os
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
 HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+# ragged-vs-dense eager alltoall crossover (nonzero cross edges)
+HOROVOD_ALLTOALL_EDGE_LIMIT = "HOROVOD_ALLTOALL_EDGE_LIMIT"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"

@@ -11,7 +11,6 @@ one more branch on the mixed-7b tap).
 
 from __future__ import annotations
 
-from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

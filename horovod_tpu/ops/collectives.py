@@ -534,14 +534,23 @@ def _eager_alltoall(x, splits, ps: ProcessSet):
                 jnp.asarray(recv_splits))
     if int(split_mat.min()) == maxs:
         return _eager_alltoall_dense(xl, split_mat, ps)
-    # per-edge program size is O(#nonzero cross edges); past ~64 edges the
+    # per-edge program size is O(#nonzero cross edges); past the limit the
     # compile cost (and per-step cache churn under jittery MoE splits)
     # outweighs the padding it avoids — fall back to the dense exchange
     n_edges = int(np.count_nonzero(split_mat)
                   - np.count_nonzero(np.diag(split_mat)))
-    if n_edges > 64:
+    if n_edges > _edge_limit():
         return _eager_alltoall_dense(xl, split_mat, ps)
     return _eager_alltoall_ragged(xl, split_mat, ps)
+
+
+def _edge_limit() -> int:
+    """Ragged-vs-dense crossover (default 64 nonzero cross edges —
+    fully-ragged nproc<=8, or sparser patterns at larger worlds). Env
+    knob mainly so tests can force the dense fallback on small worlds."""
+    from ..common import env as env_schema
+
+    return env_schema.get_int(env_schema.HOROVOD_ALLTOALL_EDGE_LIMIT, 64)
 
 
 def _np_dtype(x):
@@ -609,10 +618,13 @@ def _eager_alltoall_dense(xl, split_mat: np.ndarray, ps: ProcessSet):
 
         return (_cached(okey, build_out)(row),
                 jax.device_put(recv_splits))
-    col = np.asarray(res.addressable_data(0))[0]  # [src, maxs, ...]
+    # device_get / device_put: explicit transfers only, so the dense
+    # fallback stays usable under a transfer guard too
+    col = np.asarray(jax.device_get(res.addressable_data(0)))[0]
     parts = [col[p, : recv_splits[p]] for p in range(nproc)]
-    return (jnp.asarray(np.concatenate(parts, axis=0)),
-            jnp.asarray(recv_splits))
+    return (jax.device_put(np.ascontiguousarray(
+                np.concatenate(parts, axis=0))),
+            jax.device_put(recv_splits))
 
 
 def _eager_alltoall_ragged(xl, split_mat: np.ndarray, ps: ProcessSet):

@@ -547,6 +547,24 @@ RAGGED_DEVICE_WORKER = textwrap.dedent("""
     assert list(rs) == ([200, 2] if r == 0 else [200, 2]), rs
     assert out.shape == (202, 4), out.shape
 
+    # --- dense fallback (edge limit 0) with a device input: degrades to
+    # host staging via EXPLICIT device_get — still guard-clean ---------
+    os.environ["HOROVOD_ALLTOALL_EDGE_LIMIT"] = "0"
+    try:
+        if r == 0:
+            xs = jnp.arange(3, dtype=jnp.float32); splits = np.array([1, 2])
+        else:
+            xs = jnp.arange(10, 14, dtype=jnp.float32); splits = np.array([3, 1])
+        jax.block_until_ready(xs)
+        with jax.transfer_guard("disallow"):
+            out, rs = hvd.alltoall(xs, splits=splits)
+        out = np.asarray(out)
+        assert (list(out) == [0, 10, 11, 12]) if r == 0 else \
+            (list(out) == [1, 2, 13]), out
+        assert C._LAST_ALLTOALL_STAGING["staged"] > 0  # dense host staging
+    finally:
+        del os.environ["HOROVOD_ALLTOALL_EDGE_LIMIT"]
+
     print("RAGGED-DEVICE-OK", r)
 """)
 
