@@ -52,6 +52,24 @@ def main():
                 record(event="error", path=tag, mib=mb,
                        error=f"{type(e).__name__}: {e}"[:200])
 
+    # transfer-guard leg ON SILICON: CPU backends skip some guard checks
+    # (numpy<->host-buffer aliasing), so the real chip is the
+    # authoritative verification that the device-resident eager paths
+    # never transfer implicitly
+    try:
+        xg = jnp.ones((1 << 16,), jnp.float32)
+        jax.block_until_ready(xg)
+        with jax.transfer_guard("disallow"):
+            o1 = hvd.allreduce(xg, average=True)
+            o2 = hvd.allgather(xg.reshape(256, 256))
+            o3, _ = hvd.alltoall(xg)
+            o4 = hvd.reducescatter(xg, op=hvd.Sum)
+            jax.block_until_ready((o1, o2, o3, o4))
+        record(event="transfer_guard_ok", device=dev)
+    except Exception as e:
+        record(event="error", path="transfer_guard",
+               error=f"{type(e).__name__}: {e}"[:200])
+
     # per-dispatch latency floor: a 4-byte eager allreduce round-trip —
     # the number that explained r3's 21.7%-MFU ceiling (~2.5-3 ms)
     try:

@@ -4,6 +4,12 @@ same output format: "Img/sec per device" + total), on JAX/TPU.
 
 Example:
     python examples/jax_synthetic_benchmark.py --model ResNet50 --batch-size 64
+    python examples/jax_synthetic_benchmark.py --model InceptionV3 --image-size 299
+    python examples/jax_synthetic_benchmark.py --model VGG16
+
+Any registered model family works (ResNet50/101/152, InceptionV3,
+VGG16/19, ViT_*): models without batch norm or with dropout are handled
+uniformly.
 """
 
 import argparse
@@ -22,6 +28,8 @@ from horovod_tpu.parallel import data_parallel_step
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="ResNet50")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="input resolution (299 is InceptionV3's canonical)")
     p.add_argument("--batch-size", type=int, default=64, help="per-chip")
     p.add_argument("--num-warmup-batches", type=int, default=3)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
@@ -34,12 +42,18 @@ def main():
     model = getattr(models, args.model)(num_classes=1000, dtype=jnp.bfloat16)
     n = hvd.size()
     batch = args.batch_size * n
-    images = jnp.asarray(np.random.RandomState(0).randn(batch, 224, 224, 3),
+    sz = args.image_size
+    images = jnp.asarray(np.random.RandomState(0).randn(batch, sz, sz, 3),
                          jnp.bfloat16)
     labels = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
 
-    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    # extra rngs are ignored by models that take none (flax contract), so
+    # one init/apply shape serves BN-only, dropout-only, and plain models
+    rngs = {"params": jax.random.PRNGKey(0),
+            "dropout": jax.random.PRNGKey(17)}
+    variables = model.init(rngs, images[:2], train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
     compression = hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none
     opt = hvd.DistributedOptimizer(
         optax.sgd(0.01, momentum=0.9), compression=compression,
@@ -48,21 +62,32 @@ def main():
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     def step(state, opt_state, images, labels):
-        params, batch_stats = state
+        params, batch_stats, rng_step = state
+        rng_step, drop_key = jax.random.split(rng_step)
 
         def loss_fn(p):
-            logits, upd = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images, train=True,
-                mutable=["batch_stats"])
+            v = {"params": p}
+            if batch_stats is not None:
+                v["batch_stats"] = batch_stats
+                logits, upd = model.apply(
+                    v, images, train=True, mutable=["batch_stats"],
+                    rngs={"dropout": drop_key})
+                new_stats = upd["batch_stats"]
+            else:
+                logits = model.apply(v, images, train=True,
+                                     rngs={"dropout": drop_key})
+                new_stats = None
             onehot = jax.nn.one_hot(labels, 1000)
-            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)), upd
-        (loss, upd), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+            return loss, new_stats
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return ((optax.apply_updates(params, updates), upd["batch_stats"]),
+        return ((optax.apply_updates(params, updates), new_stats, rng_step),
                 opt_state, jax.lax.pmean(loss, "hvd"))
 
     compiled = data_parallel_step(step, batch_argnums=(2, 3))
-    state = (params, batch_stats)
+    # a fresh dropout key every step (folded through the carried state)
+    state = (params, batch_stats, jax.random.PRNGKey(42))
 
     if hvd.rank() == 0:
         print(f"Model: {args.model}, Batch size: {args.batch_size} per chip, "

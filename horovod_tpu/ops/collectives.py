@@ -620,10 +620,9 @@ def _eager_alltoall_dense(xl, split_mat: np.ndarray, ps: ProcessSet):
                 jax.device_put(recv_splits))
     # device_get / device_put: explicit transfers only, so the dense
     # fallback stays usable under a transfer guard too
-    col = np.asarray(jax.device_get(res.addressable_data(0)))[0]
+    col = jax.device_get(res.addressable_data(0))[0]
     parts = [col[p, : recv_splits[p]] for p in range(nproc)]
-    return (jax.device_put(np.ascontiguousarray(
-                np.concatenate(parts, axis=0))),
+    return (jax.device_put(np.concatenate(parts, axis=0)),
             jax.device_put(recv_splits))
 
 

@@ -267,3 +267,19 @@ def test_bench_transformer_tiny_smoke():
         out = _run([sys.executable, "-c", code],
                    env_extra={"HVD_BENCH_TRANSFORMER_OUT": tmp.name})
     assert "BT-SMOKE-OK" in out
+
+
+def test_jax_synthetic_benchmark_model_families():
+    """The JAX synthetic harness drives every headline model family
+    (reference benchmark set, docs/benchmarks.rst:11-13) — BN models,
+    the BN-free dropout VGG, and Inception's 299-style stem at a smoke
+    resolution."""
+    for model, size in (("ResNet50", "64"), ("VGG16", "64"),
+                        ("InceptionV3", "128")):
+        out = _run([sys.executable,
+                    os.path.join(EXAMPLES, "jax_synthetic_benchmark.py"),
+                    "--model", model, "--image-size", size,
+                    "--batch-size", "2", "--num-iters", "1",
+                    "--num-batches-per-iter", "1",
+                    "--num-warmup-batches", "1"], timeout=600)
+        assert "Img/sec per chip" in out, (model, out[-300:])
