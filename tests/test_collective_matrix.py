@@ -592,14 +592,15 @@ A2A_FUZZ_WORKER = textwrap.dedent("""
     r = hvd.cross_rank()
     n = hvd.cross_size()
 
-    # 40 rounds of random (often skewed, often zero) split matrices over
-    # random dtypes, trailing dims, and input residency — both ranks
+    n_rounds = int(os.environ.get("FUZZ_ROUNDS", "40"))
+    # rounds of random (often skewed, often zero) split matrices over
+    # random dtypes, trailing dims, and input residency — all ranks
     # derive the SAME split matrix from the round seed, so expectations
     # are computed locally. Stresses the per-edge ragged exchange:
     # program-cache churn, zero edges, diagonal-only rounds, pow2
     # bucketing, device-resident packing.
     dtypes = [np.float32, np.int32, np.float16]
-    for i in range(40):
+    for i in range(n_rounds):
         rng = np.random.RandomState(1000 + i)
         # split matrix [src, dest]; occasionally extreme skew or zeros
         mat = rng.randint(0, 6, size=(n, n))
@@ -610,7 +611,11 @@ A2A_FUZZ_WORKER = textwrap.dedent("""
         dt = dtypes[i % len(dtypes)]
         trail = (3,) if i % 3 == 0 else ()
         total = int(mat[r].sum())
-        base = np.arange(100 * r, 100 * r + total)
+        # stride above any possible total (<=265): every value is
+        # rank-unique so a mis-routed segment can never carry
+        # coincidentally right data — yet small enough that float16
+        # (exact integers to 2048) represents all of them exactly
+        base = np.arange(512 * r, 512 * r + total)
         x = (base[:, None] * np.ones(trail)[None, :]
              if trail else base).astype(dt)
         if i % 2 == 1:  # device-resident input on odd rounds
@@ -623,7 +628,7 @@ A2A_FUZZ_WORKER = textwrap.dedent("""
         parts = []
         for s in range(n):
             offs = np.concatenate([[0], np.cumsum(mat[s])])
-            seg = np.arange(100 * s, 100 * s + int(mat[s].sum()))[
+            seg = np.arange(512 * s, 512 * s + int(mat[s].sum()))[
                 offs[r]:offs[r + 1]]
             parts.append(seg)
         want = np.concatenate(parts)
@@ -636,11 +641,15 @@ A2A_FUZZ_WORKER = textwrap.dedent("""
 """)
 
 
-def test_alltoall_split_fuzz_soak(tmp_path):
-    """Soak the ragged per-edge alltoall: 40 random split matrices
-    (skewed hot edges, silent senders, zero rounds) x dtypes x trailing
-    dims x host/device inputs, identical derivation on both ranks."""
+@pytest.mark.parametrize("np_,rounds", [(2, 40), (4, 16)])
+def test_alltoall_split_fuzz_soak(tmp_path, monkeypatch, np_, rounds):
+    """Soak the ragged per-edge alltoall: random split matrices (skewed
+    hot edges, silent senders, zero rounds) x dtypes x trailing dims x
+    host/device inputs, identical derivation on every rank. The 4-process
+    leg exercises multi-edge rounds and mixed bucket sizes that a
+    2-process world cannot produce."""
     script = tmp_path / "worker.py"
     script.write_text(A2A_FUZZ_WORKER)
-    rc = run_commandline(["-np", "2", sys.executable, str(script)])
+    monkeypatch.setenv("FUZZ_ROUNDS", str(rounds))
+    rc = run_commandline(["-np", str(np_), sys.executable, str(script)])
     assert rc == 0
