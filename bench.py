@@ -18,8 +18,11 @@ tunneled TPU ``block_until_ready`` can acknowledge before device work
 completes, so fetching a scalar is the only trustworthy barrier.
 
 Prints ONE JSON line:
-  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+  {"metric": "<model>_images_per_sec_per_chip", "value": N,
    "unit": "images/sec/chip", "vs_baseline": N, "mfu": F, "extras": {...}}
+where <model> is resnet50 (default) or resnet101
+(``HVD_BENCH_MODEL=resnet101`` — apples-to-apples with the reference's
+published ResNet-101 number).
 """
 
 import json
@@ -33,13 +36,25 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
+from horovod_tpu.models import ResNet50, ResNet101
 from horovod_tpu.parallel import data_parallel_step
 
 BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
 
 RESNET50_FWD_FLOP_PER_IMG = 4.09e9
+RESNET101_FWD_FLOP_PER_IMG = 7.8e9  # MAC-counted, same convention
 TRAIN_FLOP_MULT = 3.0  # fwd + bwd ≈ 3x fwd
+
+# HVD_BENCH_MODEL picks the benchmarked model. resnet101 exists so the
+# vs_baseline ratio can be apples-to-apples with the reference's ONLY
+# published absolute number (ResNet-101, docs/benchmarks.rst:31-41);
+# resnet50 stays the default (BASELINE.json's driver target).
+_BENCH_MODELS = {
+    "resnet50": ("resnet50_images_per_sec_per_chip",
+                 RESNET50_FWD_FLOP_PER_IMG, ResNet50),
+    "resnet101": ("resnet101_images_per_sec_per_chip",
+                  RESNET101_FWD_FLOP_PER_IMG, ResNet101),
+}
 
 # bf16 peak FLOP/s by device kind (first matching substring wins)
 PEAK_FLOPS = [
@@ -75,10 +90,13 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
     n = hvd.size()
     s2d = os.environ.get("HVD_BENCH_S2D", "0") == "1"
     conv_impl = os.environ.get("HVD_BENCH_CONV_IMPL", "native")
-    model = (model_fn or (lambda: ResNet50(num_classes=num_classes,
-                                           dtype=jnp.bfloat16,
-                                           space_to_depth=s2d,
-                                           conv_impl=conv_impl)))()
+
+    def default_model():
+        cls = _BENCH_MODELS[_bench_model_name()][2]
+        return cls(num_classes=num_classes, dtype=jnp.bfloat16,
+                   space_to_depth=s2d, conv_impl=conv_impl)
+
+    model = (model_fn or default_model)()
     rng = jax.random.PRNGKey(0)
     batch = per_chip_batch * n
     images = jnp.asarray(
@@ -273,7 +291,8 @@ def main():
     per_chip_ips = bench_resnet(per_chip, warmup=2 if quick else 5,
                                 iters=3 if quick else 8,
                                 scan_steps=scan_steps)
-    flops = per_chip_ips * RESNET50_FWD_FLOP_PER_IMG * TRAIN_FLOP_MULT
+    metric_name, fwd_flop, _ = _BENCH_MODELS[_bench_model_name()]
+    flops = per_chip_ips * fwd_flop * TRAIN_FLOP_MULT
     mfu = flops / chip_peak_flops()
     def safe(fn, *args, **kw):
         # one failing sub-benchmark must not kill the headline number
@@ -305,9 +324,14 @@ def main():
     # publishes — ResNet-101 on 2017 Pascal GPUs (docs/benchmarks.rst:31-41)
     # — an era-mismatched denominator, labeled as such in extras.
     extras["vs_baseline_definition"] = (
-        "per-chip img/s vs reference ResNet-101 example on 16x 2017 Pascal "
-        "GPUs (docs/benchmarks.rst:31-41); era-mismatched hardware — read "
-        "mfu for the honest utilization number")
+        ("per-chip img/s vs the reference's ResNet-101 example on 16x 2017 "
+         "Pascal GPUs (docs/benchmarks.rst:31-41) — same model "
+         "(HVD_BENCH_MODEL=resnet101), era-mismatched hardware"
+         if _bench_model_name() == "resnet101" else
+         "per-chip img/s vs reference ResNet-101 example on 16x 2017 Pascal "
+         "GPUs (docs/benchmarks.rst:31-41); era- AND model-mismatched — "
+         "run HVD_BENCH_MODEL=resnet101 for apples-to-apples, read mfu "
+         "for the honest utilization number"))
     if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
         # honest metadata: this run is the forced-CPU fallback because the
         # TPU child failed/hung (wedged tunnel) — numbers are NOT chip
@@ -315,13 +339,21 @@ def main():
         extras["fallback_cpu"] = True
         extras["fallback_reason"] = os.environ["HVD_BENCH_FALLBACK_REASON"]
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": metric_name,
         "value": round(per_chip_ips, 2),
         "unit": "images/sec/chip",
         "mfu": round(mfu, 4),
         "vs_baseline": round(per_chip_ips / BASELINE_PER_DEVICE, 3),
         "extras": extras,
     }))
+
+
+def _bench_model_name() -> str:
+    name = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
+    if name not in _BENCH_MODELS:
+        raise SystemExit(f"HVD_BENCH_MODEL={name!r}: pick from "
+                         f"{sorted(_BENCH_MODELS)}")
+    return name
 
 
 def _sync_int_env(name, default):
@@ -391,6 +423,8 @@ def _parent_main() -> int:
     tunnel state instead of going red with no JSON at all."""
     import subprocess
 
+    _bench_model_name()  # a config typo must exit nonzero here, not
+    # surface as a zero-value artifact mislabeled by the fallback chain
     env = dict(os.environ)
     env[_BENCH_CHILD] = "1"
     args = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
@@ -442,8 +476,12 @@ def _parent_main() -> int:
     except subprocess.TimeoutExpired:
         fb_err = "TPU and CPU fallback both timed out"
     # last resort: one well-formed JSON artifact, whatever happened
+    try:
+        metric = _BENCH_MODELS[_bench_model_name()][0]
+    except SystemExit:
+        metric = "resnet50_images_per_sec_per_chip"
     line = json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip", "value": 0.0,
+        "metric": metric, "value": 0.0,
         "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.0,
         "extras": {"error": fb_err.replace("\n", " "),
                    "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"]},

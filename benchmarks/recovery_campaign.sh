@@ -35,7 +35,7 @@ phase() {  # phase <name> <timeout_s> <cmd...>
 }
 
 all_done() {
-  for m in resnet eager timeline probe transformer sweep bench torchshim memory; do
+  for m in resnet eager timeline probe transformer sweep bench r101 torchshim memory; do
     [ -f "benchmarks/markers/$m.done" ] || return 1
   done
   return 0
@@ -67,6 +67,7 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     phase transformer 2700 python benchmarks/bench_transformer.py && \
     phase sweep      3600  python benchmarks/mfu_campaign.py     && \
     phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r4_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r4_chip.tmp && ! grep -q fallback benchmarks/.bench_r4_chip.tmp && mv benchmarks/.bench_r4_chip.tmp benchmarks/bench_r4_chip.json' && \
+    phase r101       5400  bash -c 'set -o pipefail; HVD_BENCH_MODEL=resnet101 python bench.py | tee benchmarks/.bench_r4_r101.tmp && grep -q resnet101 benchmarks/.bench_r4_r101.tmp && ! grep -q fallback benchmarks/.bench_r4_r101.tmp && mv benchmarks/.bench_r4_r101.tmp benchmarks/bench_r4_resnet101.json' && \
     phase torchshim   900  python benchmarks/torch_shim_phase.py && \
     phase memory     1800  python benchmarks/memory_analysis.py --big
   else

@@ -337,3 +337,31 @@ def test_bench_parent_fallback_emits_parseable_json(monkeypatch, capsys, tmp_pat
     parsed = _json.loads(last)
     assert parsed["extras"]["fallback_cpu"] is True
     assert (cap.err + cap.out)[-500:].rstrip().endswith(last)
+
+
+def test_bench_model_selection(monkeypatch):
+    """HVD_BENCH_MODEL switches the benchmarked model + FLOP constant
+    (resnet101 = apples-to-apples with the reference's only published
+    absolute number); unknown names fail loudly."""
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import jax.numpy as jnp
+
+    import bench
+    from horovod_tpu import models
+
+    monkeypatch.setenv("HVD_BENCH_MODEL", "resnet101")
+    assert bench._bench_model_name() == "resnet101"
+    metric, flop, cls_name = bench._BENCH_MODELS["resnet101"]
+    assert metric == "resnet101_images_per_sec_per_chip"
+    assert flop > bench.RESNET50_FWD_FLOP_PER_IMG
+    m = getattr(models, cls_name)(num_classes=10, dtype=jnp.bfloat16,
+                                  space_to_depth=False, conv_impl="native")
+    assert list(m.stage_sizes) == [3, 4, 23, 3]
+
+    monkeypatch.setenv("HVD_BENCH_MODEL", "vgg16")
+    with pytest.raises(SystemExit, match="HVD_BENCH_MODEL"):
+        bench._bench_model_name()
+    monkeypatch.delenv("HVD_BENCH_MODEL")
+    assert bench._bench_model_name() == "resnet50"
