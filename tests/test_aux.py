@@ -353,11 +353,12 @@ def test_bench_model_selection(monkeypatch):
 
     monkeypatch.setenv("HVD_BENCH_MODEL", "resnet101")
     assert bench._bench_model_name() == "resnet101"
-    metric, flop, cls_name = bench._BENCH_MODELS["resnet101"]
+    metric, flop, cls = bench._BENCH_MODELS["resnet101"]
     assert metric == "resnet101_images_per_sec_per_chip"
     assert flop > bench.RESNET50_FWD_FLOP_PER_IMG
-    m = getattr(models, cls_name)(num_classes=10, dtype=jnp.bfloat16,
-                                  space_to_depth=False, conv_impl="native")
+    assert cls is models.ResNet101
+    m = cls(num_classes=10, dtype=jnp.bfloat16,
+            space_to_depth=False, conv_impl="native")
     assert list(m.stage_sizes) == [3, 4, 23, 3]
 
     monkeypatch.setenv("HVD_BENCH_MODEL", "vgg16")
