@@ -262,11 +262,16 @@ def main():
     # available (benchmarks/mfu_campaign.py writes the winning config);
     # env vars always win
     tuned_batch, tuned_scan = 256, 4
+    if _bench_model_name() != "resnet50":
+        # the tuned file was swept FOR resnet50; a deeper model at that
+        # batch risks burning a chip window on an OOM — start from a
+        # conservative default (env vars still override)
+        tuned_batch, tuned_scan = 128, 4
     # per-machine file: only honored in single-process runs — multi-host
     # ranks could read different local files and submit mismatched
     # collective shapes (env vars are launcher-propagated, so they stay
     # the cross-process path)
-    if hvd.cross_size() <= 1:
+    if hvd.cross_size() <= 1 and _bench_model_name() == "resnet50":
         try:
             with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                    "benchmarks", "bench_tuned.json")) as f:
