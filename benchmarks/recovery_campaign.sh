@@ -55,7 +55,7 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     # phase has no watchdog — bench.py's parent wrapper manages its own
     # child timeouts (worst case ~80 min) — and commits its artifact
     # via tmp+mv only after validation, so a fallback/truncated run
-    # never leaves a bad bench_r3_chip.json behind. The memory phase
+    # never leaves a bad bench_r5_chip.json behind. The memory phase
     # records HBM CompiledMemoryStats evidence last.
     # resnet first (headline + warms the bench compile cache), then the
     # two cheap VERDICT-r3 artifact phases (eager GB/s rows, on-chip
@@ -66,8 +66,8 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     phase probe       900  python benchmarks/probe_conv.py       && \
     phase transformer 2700 python benchmarks/bench_transformer.py && \
     phase sweep      3600  python benchmarks/mfu_campaign.py     && \
-    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r4_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r4_chip.tmp && ! grep -q fallback benchmarks/.bench_r4_chip.tmp && mv benchmarks/.bench_r4_chip.tmp benchmarks/bench_r4_chip.json' && \
-    phase r101       5400  bash -c 'set -o pipefail; HVD_BENCH_MODEL=resnet101 python bench.py | tee benchmarks/.bench_r4_r101.tmp && grep -q resnet101 benchmarks/.bench_r4_r101.tmp && ! grep -q fallback benchmarks/.bench_r4_r101.tmp && mv benchmarks/.bench_r4_r101.tmp benchmarks/bench_r4_resnet101.json' && \
+    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r5_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r5_chip.tmp && ! grep -q fallback benchmarks/.bench_r5_chip.tmp && mv benchmarks/.bench_r5_chip.tmp benchmarks/bench_r5_chip.json' && \
+    phase r101       5400  bash -c 'set -o pipefail; HVD_BENCH_MODEL=resnet101 python bench.py | tee benchmarks/.bench_r5_r101.tmp && grep -q resnet101 benchmarks/.bench_r5_r101.tmp && ! grep -q fallback benchmarks/.bench_r5_r101.tmp && mv benchmarks/.bench_r5_r101.tmp benchmarks/bench_r5_resnet101.json' && \
     phase torchshim   900  python benchmarks/torch_shim_phase.py && \
     phase memory     1800  python benchmarks/memory_analysis.py --big
   else

@@ -1,0 +1,103 @@
+"""``horovod`` — drop-in compatibility alias for :mod:`horovod_tpu`.
+
+The reference framework is imported as ``horovod`` (reference
+horovod/__init__.py re-exports ``horovod.runner.run``; user scripts do
+``import horovod.torch as hvd`` — e.g. reference
+examples/pytorch/pytorch_mnist.py:11). This package lets those scripts
+run **unmodified** against the TPU-native implementation: every
+``horovod.X`` submodule import is answered with the *same module
+object* as ``horovod_tpu.X``, via a meta-path finder installed on first
+``import horovod``.
+
+Aliasing by module identity (not a parallel re-import) matters: the
+framework holds process-global state (``horovod_tpu.common.context``),
+and a second copy of the package would mean a second background
+runtime, a second atexit hook, and diverging rank/size views. With the
+finder, ``horovod.torch is horovod_tpu.torch`` holds and there is a
+single runtime regardless of which name a library imported it under.
+
+The finder sits at the FRONT of ``sys.meta_path``: under an aliased
+parent (whose ``__path__`` points into ``horovod_tpu/``) the stock
+PathFinder would otherwise re-load nested submodules as fresh
+``horovod.*``-named copies.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import sys
+
+import horovod_tpu as _hvd_tpu
+
+__version__ = getattr(_hvd_tpu, "__version__", "0.1.0")
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Loader that resolves ``horovod.X`` to the already-importable
+    ``horovod_tpu.X`` module object itself."""
+
+    def __init__(self, real_name: str):
+        self._real_name = real_name
+
+    def create_module(self, spec):
+        return importlib.import_module(self._real_name)
+
+    def exec_module(self, module):
+        # Already executed under its real name; restore the attributes
+        # the import machinery rewrote when it adopted our spec, so the
+        # module keeps identifying as horovod_tpu.* (relative imports
+        # inside it, repr, and pickling stay consistent).
+        module.__name__ = self._real_name
+        module.__package__ = (
+            self._real_name
+            if hasattr(module, "__path__")
+            else self._real_name.rpartition(".")[0]
+        )
+        spec = getattr(module, "__spec__", None)
+        if spec is not None and spec.name != self._real_name:
+            spec.name = self._real_name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    _PREFIX = "horovod."
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._PREFIX):
+            return None
+        real_name = "horovod_tpu." + fullname[len(self._PREFIX):]
+        try:
+            real_spec = importlib.util.find_spec(real_name)
+        except (ImportError, ValueError):
+            return None
+        if real_spec is None:
+            return None
+        spec = importlib.machinery.ModuleSpec(
+            fullname,
+            _AliasLoader(real_name),
+            is_package=real_spec.submodule_search_locations is not None,
+        )
+        # Reuse the real search locations so _init_module_attrs writes
+        # the module's own __path__ back onto it unchanged.
+        spec.submodule_search_locations = real_spec.submodule_search_locations
+        return spec
+
+
+def _install():
+    if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+        sys.meta_path.insert(0, _AliasFinder())
+
+
+_install()
+
+
+def __getattr__(name):
+    # top-level API parity: horovod.run (reference horovod/__init__.py:1)
+    # plus the basics surface horovod_tpu exports (rank/size/init/...).
+    if name == "run":
+        from horovod_tpu.runner import run
+
+        return run
+    return getattr(_hvd_tpu, name)

@@ -209,12 +209,26 @@ def _install_fatal_exit_hook():
     263-271) can only fire once this process is actually gone — so after
     reporting the error we flush and hard-exit before teardown reaches
     that destructor. Normal completion and sys.exit() keep the clean
-    path (the barrier is then bounded by real rank skew)."""
+    path (the barrier is then bounded by real rank skew).
+
+    Scope: only launcher-spawned workers (HOROVOD_RANK in the env) get
+    the hook — a user-embedded driver that initializes jax.distributed
+    itself keeps standard teardown (atexit handlers, coverage, tempfile
+    cleanup). KeyboardInterrupt keeps its conventional 130 exit code.
+    (Uncaught SystemExit never reaches sys.excepthook — the interpreter
+    handles it first — so sys.exit() takes the normal teardown path,
+    which is the desired behavior anyway.)"""
     import sys
+
+    if os.environ.get(env_schema.HOROVOD_RANK) is None:
+        return
 
     prev = sys.excepthook
 
     def hook(tp, val, tb):
+        code = 1
+        if issubclass(tp, KeyboardInterrupt):
+            code = 130  # 128 + SIGINT, the shell convention
         try:
             # inside the try: a raising prev hook (or a torn-down stderr
             # pipe) must not skip the hard exit — lingering is the exact
@@ -223,7 +237,7 @@ def _install_fatal_exit_hook():
             sys.stdout.flush()
             sys.stderr.flush()
         finally:
-            os._exit(1)
+            os._exit(code)
 
     sys.excepthook = hook
 

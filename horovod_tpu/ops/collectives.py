@@ -751,7 +751,13 @@ def _eager_alltoall_ragged(xl, split_mat: np.ndarray, ps: ProcessSet):
             if d == me:
                 rows[s] = r.addressable_data(0)  # [1, blens[s][d], ...]
         srcs = sorted(rows)
-        akey = ("a2a_asm", tuple(int(v) for v in split_mat[:, me]),
+        # ps.name + me in the key: the compiled closure captures this
+        # process set's cross_rank (self-segment position and part
+        # ordering), so two sets with coincidentally equal splits/shapes
+        # must not share the program (sibling keys ag_compact /
+        # alltoall_ragged already scope by set)
+        akey = ("a2a_asm", ps.name, me,
+                tuple(int(v) for v in split_mat[:, me]),
                 tuple(srcs), tuple(int(rows[s].shape[1]) for s in srcs),
                 int(xl.shape[0]), tuple(int(v) for v in offs), rest,
                 str(dtype))

@@ -1,1 +1,17 @@
+"""Launcher package (reference: horovod/runner/__init__.py).
 
+Exposes the programmatic ``run()`` API lazily (reference
+runner/__init__.py:92 defines it inline; ours lives in launch.py) so
+that ``import horovod_tpu.runner`` — and the ``horovod.runner`` compat
+alias — stay import-cheap.
+"""
+
+__all__ = ["run", "run_commandline"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import launch
+
+        return getattr(launch, name)
+    raise AttributeError(name)

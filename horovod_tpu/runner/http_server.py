@@ -52,10 +52,18 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = self.server.secret_key  # type: ignore[attr-defined]
         if not key:
             return True
+        ts = self.headers.get(_secret.TS_HEADER) or ""
+        try:
+            skew = abs(time.time() - float(ts))
+        except ValueError:
+            return False
+        if skew > _secret.MAX_SKEW_SECONDS:
+            return False  # stale (or far-future) signed request: replay
         return _secret.check_digest(
             key, self.headers.get(_secret.DIGEST_HEADER),
             self.command.encode(), self._key().encode(),
-            (self.headers.get("X-Exclude-Prefix") or "").encode(), body)
+            (self.headers.get("X-Exclude-Prefix") or "").encode(),
+            ts.encode(), body)
 
     def _reject(self):
         self.send_response(403)
@@ -172,16 +180,22 @@ class KVStoreClient:
                  exclude: str = "") -> dict:
         if not self._secret:
             return {}
-        return {_secret.DIGEST_HEADER: _secret.request_digest(
-            self._secret, method, path, body, exclude)}
+        ts = f"{time.time():.6f}"
+        return {
+            _secret.TS_HEADER: ts,
+            _secret.DIGEST_HEADER: _secret.request_digest(
+                self._secret, method, path, body, exclude, ts=ts),
+        }
 
     @staticmethod
     def _raise_on_403(e: HTTPError, what: str):
         if e.code == 403:
             raise KVAuthError(
-                f"KV store refused {what}: HMAC digest rejected (secret "
-                "key mismatch — is HOROVOD_SECRET_KEY consistent across "
-                "the job?)") from e
+                f"KV store refused {what}: HMAC digest rejected — either "
+                "the secret key differs (is HOROVOD_SECRET_KEY consistent "
+                "across the job?) or this host's clock is more than "
+                f"{_secret.MAX_SKEW_SECONDS:.0f}s off the store's "
+                "(replay-window check; verify NTP)") from e
         raise
 
     def put(self, scope: str, key: str, value: bytes):

@@ -25,13 +25,55 @@ LOG = logging.getLogger("horovod_tpu")
 LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _local_identity() -> tuple[frozenset, frozenset]:
+    """(own names, own addresses) — computed once per process: the
+    launcher and the elastic driver call is_local_host in per-slot loops
+    every (re)discovery cycle, and blocking DNS work there multiplies."""
+    names = {socket.gethostname()}
+    try:
+        names.add(socket.getfqdn())
+    except OSError:
+        pass
+    addrs = {"127.0.0.1", "::1"}
+    try:
+        addrs.update(ai[4][0] for ai in socket.getaddrinfo(
+            socket.gethostname(), None))
+    except OSError:
+        pass
+    return frozenset(names), frozenset(addrs)
+
+
+@functools.lru_cache(maxsize=256)
 def is_local_host(hostname: str) -> bool:
-    return hostname in LOCAL_NAMES or hostname == socket.gethostname()
+    """True when ``hostname`` names this machine — shortname, FQDN, or a
+    loopback literal. Matching the FQDN matters operationally: a
+    ``-H <local-fqdn>:N`` job must exec its slots directly, not SSH to
+    itself (and must not run the remote route probe at all)."""
+    if hostname in LOCAL_NAMES:
+        return True
+    names, local_addrs = _local_identity()
+    if hostname in names:
+        return True
+    try:
+        # last resort: does the name resolve to one of our own addresses?
+        addrs = {ai[4][0] for ai in socket.getaddrinfo(hostname, None)}
+    except OSError:
+        return False
+    return bool(addrs & local_addrs)
 
 
 def interface_address(ifname: str) -> str:
     """IPv4 address bound to ``ifname`` (Linux SIOCGIFADDR ioctl — the
-    stdlib has no interface->address map)."""
+    stdlib has no interface->address map).
+
+    IPv4-only by construction: SIOCGIFADDR has no AF_INET6 variant, so
+    an IPv6-only NIC raises the ValueError below naming the limitation
+    (workers on v6-only fabrics should pass a literal coordinator
+    address instead of --network-interface)."""
     import fcntl
 
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -43,7 +85,8 @@ def interface_address(ifname: str) -> str:
         raise ValueError(
             f"--network-interface {ifname!r}: cannot read an IPv4 address "
             f"({e.strerror or e}); check the interface name with `ip -4 "
-            "addr`") from e
+            "addr` (note: IPv6-only interfaces are not supported here — "
+            "pass the coordinator address explicitly instead)") from e
     finally:
         s.close()
 

@@ -6,10 +6,15 @@ driver/task message carries an HMAC digest the receiver verifies, and
 responses are signed back). There the wire is pickled TCP messages; here
 the control plane is the HTTP KV store, so the digest rides an
 ``X-HVD-Digest`` header computed over the request's semantic content
-(method, path, mutating headers, body) and, on reads, over the response
-body — a rogue process that can reach the store's port can neither
-poison a negotiation round nor impersonate the store without the
-launcher-injected key.
+(method, path, mutating headers, signed timestamp, body) and, on reads,
+over the response body — a rogue process that can reach the store's
+port can neither poison a negotiation round nor impersonate the store
+without the launcher-injected key. Against an attacker who can also
+*sniff* the wire, the signed ``X-HVD-TS`` timestamp bounds replay of a
+captured request to MAX_SKEW_SECONDS (full replay immunity would need a
+per-request server nonce round-trip, judged not worth doubling every KV
+exchange for a control plane that normally rides a private cluster
+network).
 
 The key travels to workers the same way the reference delivers it: as
 per-slot environment (``HOROVOD_SECRET_KEY``, reference
@@ -25,6 +30,14 @@ import secrets as _secrets
 from ..common import env as env_schema
 
 DIGEST_HEADER = "X-HVD-Digest"
+TS_HEADER = "X-HVD-TS"
+
+# Requests older (or newer) than this are refused even with a valid
+# digest: it bounds the replay window for an attacker who can *sniff*
+# the wire, not just connect (a captured delete sweep or PUT can only
+# be replayed for this long). NTP-synced cluster hosts sit well inside
+# it.
+MAX_SKEW_SECONDS = 300.0
 
 
 def make_secret_key() -> str:
@@ -67,12 +80,15 @@ def check_digest(key: str, digest: str | None, *parts: bytes) -> bool:
 
 
 def request_digest(key: str, method: str, path: str, body: bytes = b"",
-                   exclude: str = "") -> str:
+                   exclude: str = "", ts: str = "") -> str:
     """Digest for a KV request. ``exclude`` is the DELETE sweep's
     X-Exclude-Prefix header — it changes what the request does, so it is
-    part of the signed material."""
+    part of the signed material. ``ts`` is the sender's clock
+    (X-HVD-TS): signing it gives requests freshness, so a sniffed
+    request replays for at most MAX_SKEW_SECONDS (the reference's
+    pickled-TCP HMAC scheme has no freshness at all)."""
     return compute_digest(key, method.encode(), path.encode(),
-                          exclude.encode(), body)
+                          exclude.encode(), ts.encode(), body)
 
 
 def response_digest(key: str, path: str, body: bytes) -> str:

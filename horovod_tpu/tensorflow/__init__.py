@@ -128,11 +128,24 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
     @tf.custom_gradient
     def _op(t_in):
         t, ctx = compression.compress(t_in)
-        h = _core.allreduce_async(_to_np(t), average, name, op=op,
-                                  prescale_factor=prescale_factor,
-                                  postscale_factor=postscale_factor,
-                                  process_set=process_set)
-        out = _from_np(_core.synchronize(h), t.dtype)
+
+        def _bridge(x):
+            h = _core.allreduce_async(_to_np(x), average, name, op=op,
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
+                                      process_set=process_set)
+            return _from_np(_core.synchronize(h), t.dtype)
+
+        # Under tf.function the tensors are symbolic; the numpy bridge
+        # must run at step time, not trace time. tf.py_function is the
+        # graph-mode seam (the reference's AsyncOpKernels serve both
+        # modes natively, mpi_ops.cc:383-431 — our XLA data plane keeps
+        # one eager runtime and bridges the graph into it).
+        if tf.executing_eagerly():
+            out = _bridge(t)
+        else:
+            out = tf.py_function(_bridge, [t], t.dtype)
+            out.set_shape(t.shape)
         out = compression.decompress(out, ctx)
 
         def grad(dy):
@@ -171,14 +184,26 @@ def grouped_allreduce(tensors, average=None, device_dense="",
     @tf.custom_gradient
     def _op(*ts):
         comp = [compression.compress(t) for t in ts]
-        hs = [_core.allreduce_async(_to_np(t), average, f"{base}.{i}", op=op,
-                                    prescale_factor=prescale_factor,
-                                    postscale_factor=postscale_factor,
-                                    process_set=process_set)
-              for i, (t, _) in enumerate(comp)]
-        outs = [compression.decompress(
-                    _from_np(_core.synchronize(h), t.dtype), c)
-                for h, (t, c) in zip(hs, comp)]
+        dtypes = [t.dtype for t, _ in comp]
+
+        def _bridge(*xs):
+            hs = [_core.allreduce_async(_to_np(x), average, f"{base}.{i}",
+                                        op=op,
+                                        prescale_factor=prescale_factor,
+                                        postscale_factor=postscale_factor,
+                                        process_set=process_set)
+                  for i, x in enumerate(xs)]
+            return [_from_np(_core.synchronize(h), d)
+                    for h, d in zip(hs, dtypes)]
+
+        if tf.executing_eagerly():
+            raw = _bridge(*[t for t, _ in comp])
+        else:
+            raw = tf.py_function(_bridge, [t for t, _ in comp], dtypes)
+            for o, (t, _) in zip(raw, comp):
+                o.set_shape(t.shape)
+        outs = [compression.decompress(o, c)
+                for o, (_, c) in zip(raw, comp)]
 
         def grad(*dys):
             # gradient of a grouped allreduce is a grouped allreduce of
@@ -203,31 +228,57 @@ def allgather(tensor, name: Optional[str] = None,
 
     @tf.custom_gradient
     def _op(t_in):
-        arr = _to_np(t_in)
-        local_rows = int(arr.shape[0]) if arr.ndim else 0
-        h = _core.allgather_async(arr, name, process_set=process_set)
-        out = _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+        graph_mode = not tf.executing_eagerly()
+        rows_cell: list[int] = []  # runtime row count, set by the forward
         start_cache: list[int] = []  # memoized (persistent tapes)
+
+        def _bridge(x):
+            arr = _to_np(x)
+            rows_cell[:] = [int(arr.shape[0]) if arr.ndim else 0]
+            h = _core.allgather_async(arr, name, process_set=process_set)
+            return _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+
+        if graph_mode:
+            out = tf.py_function(_bridge, [t_in], tf.as_dtype(t_in.dtype))
+            out.set_shape(tf.TensorShape([None]).concatenate(
+                t_in.shape[1:]))
+        else:
+            out = _bridge(t_in)
 
         def grad(dy):
             red = allreduce(dy, average=True, process_set=process_set,
                             name=f"{name}.grad" if name else None)
-            ps = process_set or global_process_set()
-            if not start_cache:
-                if ps.cross_size <= 1:
-                    start_cache.append(0)
-                else:
-                    # workers contributed rows in rank order; ragged
-                    # inputs need everyone's row counts (one exchange,
-                    # backward-only, memoized)
-                    sizes = _core.synchronize(_core.allgather_async(
-                        np.asarray([local_rows]),
-                        f"{name or 'allgather'}.grad.sizes",
-                        process_set=process_set))
-                    start_cache.append(
-                        int(np.sum(np.asarray(sizes)[:ps.cross_rank])))
-            start = start_cache[0]
-            return red[start:start + local_rows]
+
+            def _slice(r):
+                # workers contributed rows in rank order; ragged inputs
+                # need everyone's row counts (one exchange, backward-only).
+                # Eager mode memoizes it: the closure is fresh per forward
+                # call, so the memo only ever serves repeated backward of
+                # the same forward (persistent tapes — row counts fixed).
+                # Graph mode must NOT memoize: the closure persists across
+                # step executions, rows can differ per step (final partial
+                # batch), and a rank skipping the exchange while another
+                # runs it would deadlock the collective.
+                local_rows = rows_cell[0]
+                ps = process_set or global_process_set()
+                if graph_mode or not start_cache:
+                    if ps.cross_size <= 1:
+                        start_cache[:] = [0]
+                    else:
+                        sizes = _core.synchronize(_core.allgather_async(
+                            np.asarray([local_rows]),
+                            f"{name or 'allgather'}.grad.sizes",
+                            process_set=process_set))
+                        start_cache[:] = [
+                            int(np.sum(np.asarray(sizes)[:ps.cross_rank]))]
+                start = start_cache[0]
+                return r[start:start + local_rows]
+
+            if graph_mode:
+                back = tf.py_function(_slice, [red], red.dtype)
+                back.set_shape(t_in.shape)
+                return back
+            return _slice(red)
 
         return out, grad
 
@@ -241,9 +292,16 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 
     @tf.custom_gradient
     def _op(t_in):
-        h = _core.broadcast_async(_to_np(t_in), root_rank, name,
-                                  process_set=process_set)
-        out = _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+        def _bridge(x):
+            h = _core.broadcast_async(_to_np(x), root_rank, name,
+                                      process_set=process_set)
+            return _from_np(_core.synchronize(h), tf.as_dtype(t_in.dtype))
+
+        if tf.executing_eagerly():
+            out = _bridge(t_in)
+        else:
+            out = tf.py_function(_bridge, [t_in], tf.as_dtype(t_in.dtype))
+            out.set_shape(t_in.shape)
 
         def grad(dy):
             red = allreduce(dy, average=True, process_set=process_set,
@@ -269,29 +327,54 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     cotangent routes back with splits = received_splits)."""
     @tf.custom_gradient
     def _op(t_in):
-        h = _core.alltoall_async(_to_np(t_in),
-                                 None if splits is None else _to_np(splits),
-                                 name, process_set=process_set)
-        out, recv = _core.synchronize(h)
-        recv = np.asarray(recv)
+        def _bridge(x, s=None):
+            h = _core.alltoall_async(
+                _to_np(x), None if s is None else _to_np(s),
+                name, process_set=process_set)
+            out, recv = _core.synchronize(h)
+            recv = np.asarray(recv)
+            return (_from_np(out, tf.as_dtype(t_in.dtype)),
+                    tf.constant(recv, dtype=tf.int32))
+
+        if tf.executing_eagerly():
+            out_t, recv_t = _bridge(t_in, splits)
+        else:
+            # splits may itself be a graph tensor (the backward path
+            # feeds the forward's received_splits) — it must enter the
+            # py_function as an input, not a closure capture
+            inp = [t_in] if splits is None else [t_in, splits]
+            out_t, recv_t = tf.py_function(
+                _bridge, inp, [tf.as_dtype(t_in.dtype), tf.int32])
+            out_t.set_shape(tf.TensorShape([None]).concatenate(
+                t_in.shape[1:]))
+            recv_t.set_shape(tf.TensorShape([None]))
 
         def grad(dy, _drecv=None):
-            back, _ = alltoall(dy, splits=recv,
+            # the cotangent routes back with splits = received_splits;
+            # recv_t is the forward's runtime output, a valid input to
+            # the backward graph in both modes
+            back, _ = alltoall(dy, splits=recv_t,
                                name=f"{name}.grad" if name else None,
                                process_set=process_set)
             return back
 
-        return (_from_np(out, tf.as_dtype(t_in.dtype)),
-                tf.constant(recv, dtype=tf.int32)), grad
+        return (out_t, recv_t), grad
 
     return _op(tensor)
 
 
 def reducescatter(tensor, op=None, name: Optional[str] = None,
                   process_set: Optional[ProcessSet] = None):
-    h = _core.reducescatter_async(_to_np(tensor), name, op=op,
-                                  process_set=process_set)
-    return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+    def _bridge(x):
+        h = _core.reducescatter_async(_to_np(x), name, op=op,
+                                      process_set=process_set)
+        return _from_np(_core.synchronize(h), tf.as_dtype(tensor.dtype))
+
+    if tf.executing_eagerly():
+        return _bridge(tensor)
+    out = tf.py_function(_bridge, [tensor], tf.as_dtype(tensor.dtype))
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
 
 
 def join() -> int:

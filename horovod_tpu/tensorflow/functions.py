@@ -19,6 +19,19 @@ def broadcast_variables(variables, root_rank: int = 0,
     """Assign every variable the root rank's value (reference
     functions.py:47). Called once after init / checkpoint restore so all
     workers start identically."""
+    if not tf.executing_eagerly():
+        # under tf.function (the reference example broadcasts inside the
+        # first traced step, reference examples/tensorflow2/
+        # tensorflow2_mnist.py:75-77): use the graph-capable broadcast
+        # op, which bridges through tf.py_function at step time
+        from . import broadcast as _broadcast_op
+
+        for i, v in enumerate(variables):
+            name = f"bcast.tf.{i}.{getattr(v, 'name', '') or 'var'}"
+            val = _broadcast_op(tf.convert_to_tensor(v), root_rank,
+                                name=name, process_set=process_set)
+            v.assign(tf.cast(val, v.dtype))
+        return
     handles = []
     for i, v in enumerate(variables):
         # index-prefixed: Keras 3 variable names are not unique ("bias"

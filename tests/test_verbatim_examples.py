@@ -1,0 +1,101 @@
+"""Reference examples run VERBATIM against the ``horovod`` alias package.
+
+SURVEY.md §7 step 3 / VERDICT r4 item 3: copy the reference user
+scripts byte-for-byte (reference examples/pytorch/pytorch_mnist.py,
+examples/tensorflow2/tensorflow2_mnist.py) — no import edits — and run
+them green under ``hvdrun -np 2``. The only injection is the
+dataset-download shim dir (tests/verbatim_support: synthetic MNIST +
+a torchvision stand-in), because this image has zero egress.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUPPORT = os.path.join(REPO, "tests", "verbatim_support")
+REFERENCE_EXAMPLES = "/root/reference/examples"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_EXAMPLES), reason="reference checkout absent"
+)
+
+
+def _run_verbatim(tmp_path, rel_script, *args, timeout=900, env_extra=None):
+    src = os.path.join(REFERENCE_EXAMPLES, rel_script)
+    script = os.path.join(str(tmp_path), os.path.basename(rel_script))
+    shutil.copyfile(src, script)  # byte-for-byte; no edits
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # shim dir first (sitecustomize + torchvision), then the repo for
+    # the horovod alias package itself
+    env["PYTHONPATH"] = (
+        SUPPORT + os.pathsep + REPO + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env["HVD_VERBATIM_MNIST_N"] = "512"
+    if env_extra:
+        env.update(env_extra)
+    worker_env = []
+    for k in ("JAX_PLATFORMS", "PYTHONPATH", "HVD_VERBATIM_MNIST_N",
+              "HVD_VERBATIM_MNIST_DIM", "TF_USE_LEGACY_KERAS"):
+        if k in env:
+            worker_env += ["--env", f"{k}={env[k]}"]
+    worker_env += ["--env", "PALLAS_AXON_POOL_IPS="]
+    p = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         *worker_env, sys.executable, script, *args],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=timeout)
+    assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-3000:])
+    return p.stdout
+
+
+@needs_reference
+def test_alias_package_identity():
+    """horovod.X is horovod_tpu.X — one runtime, not a parallel copy."""
+    code = (
+        "import horovod, horovod.torch, horovod_tpu.torch\n"
+        "assert horovod.torch is horovod_tpu.torch\n"
+        "import horovod.tensorflow.keras, horovod_tpu.tensorflow.keras\n"
+        "assert horovod.tensorflow.keras is horovod_tpu.tensorflow.keras\n"
+        "from horovod.runner import run; assert callable(run)\n"
+        "from horovod import run as r2; assert r2 is run\n"
+        "print('ALIAS-OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    assert "ALIAS-OK" in p.stdout
+
+
+@needs_reference
+def test_reference_pytorch_mnist_verbatim(tmp_path):
+    """reference examples/pytorch/pytorch_mnist.py:11 `import
+    horovod.torch as hvd` — unmodified, 2 processes, 1 epoch."""
+    out = _run_verbatim(tmp_path, "pytorch/pytorch_mnist.py",
+                        "--epochs", "1", "--data-dir", str(tmp_path))
+    assert "Test set: Average loss" in out
+
+
+@needs_reference
+def test_reference_tensorflow2_mnist_verbatim(tmp_path):
+    """reference examples/tensorflow2/tensorflow2_mnist.py:17 `import
+    horovod.tensorflow as hvd` — unmodified. The script's step count is
+    hardcoded (10000 // size); the dataset shim keeps images small
+    (HVD_VERBATIM_MNIST_DIM) so 5000 CPU steps stay cheap."""
+    out = _run_verbatim(
+        tmp_path, "tensorflow2/tensorflow2_mnist.py", timeout=1500,
+        env_extra={"HVD_VERBATIM_MNIST_DIM": "8",
+                   "TF_USE_LEGACY_KERAS": "1"})
+    assert "Step #" in out
+    assert os.path.exists(os.path.join(str(tmp_path), "checkpoints-1.index")) or any(
+        n.startswith("checkpoints") for n in os.listdir(str(tmp_path)))

@@ -1,0 +1,99 @@
+"""Dataset-download shims for running the REFERENCE examples verbatim.
+
+The north-star contract (SURVEY.md §7 step 3) is that reference user
+scripts — e.g. reference examples/tensorflow2/tensorflow2_mnist.py:29,
+which calls ``tf.keras.datasets.mnist.load_data`` — run **unmodified**
+against the ``horovod`` alias package. This image has zero egress, so
+the one thing we may inject is the dataset download itself: this
+sitecustomize (put on PYTHONPATH only by tests/test_verbatim_examples.py)
+installs a post-import patch that replaces keras's MNIST ``load_data``
+with a synthetic in-memory generator. No horovod/model/step code is
+touched.
+
+It also chain-loads the system sitecustomize it shadows (the axon TPU
+plugin hook), since Python imports only the first one found.
+"""
+
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import os
+import sys
+
+_TARGETS = {
+    "keras.datasets.mnist", "keras.src.datasets.mnist",
+    # legacy-keras spellings (TF_USE_LEGACY_KERAS=1 → tf.keras is
+    # tf_keras, matching the reference's Keras-2-era API)
+    "tf_keras.datasets.mnist", "tf_keras.src.datasets.mnist",
+}
+
+
+def _synthetic_mnist_load_data(path="mnist.npz"):
+    """Drop-in for keras.datasets.mnist.load_data: deterministic synthetic
+    digits, sized by HVD_VERBATIM_MNIST_DIM/N so CI steps stay cheap."""
+    import numpy as np
+
+    dim = int(os.environ.get("HVD_VERBATIM_MNIST_DIM", "10"))
+    n = int(os.environ.get("HVD_VERBATIM_MNIST_N", "512"))
+    rng = np.random.RandomState(0)
+
+    def split(count):
+        x = rng.randint(0, 256, size=(count, dim, dim)).astype("uint8")
+        y = rng.randint(0, 10, size=(count,)).astype("uint8")
+        return x, y
+
+    return split(n), split(max(n // 2, 1))
+
+
+def _patch(module):
+    module.load_data = _synthetic_mnist_load_data
+
+
+class _PatchingLoader(importlib.abc.Loader):
+    def __init__(self, wrapped):
+        self._wrapped = wrapped
+
+    def __getattr__(self, name):
+        return getattr(self._wrapped, name)
+
+    def create_module(self, spec):
+        return self._wrapped.create_module(spec)
+
+    def exec_module(self, module):
+        self._wrapped.exec_module(module)
+        _patch(module)
+
+
+class _MnistShimFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname not in _TARGETS:
+            return None
+        sys.meta_path.remove(self)
+        try:
+            spec = importlib.util.find_spec(fullname)
+        finally:
+            sys.meta_path.insert(0, self)
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _PatchingLoader(spec.loader)
+        return spec
+
+
+if not any(isinstance(f, _MnistShimFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _MnistShimFinder())
+
+# chain-load the sitecustomize this file shadows (first match on the
+# remaining path entries that isn't us)
+_here = os.path.dirname(os.path.abspath(__file__))
+for _p in sys.path:
+    _cand = os.path.join(_p or ".", "sitecustomize.py")
+    if os.path.abspath(os.path.dirname(_cand)) == _here:
+        continue
+    if os.path.isfile(_cand):
+        _spec = importlib.util.spec_from_file_location("_chained_sitecustomize", _cand)
+        _mod = importlib.util.module_from_spec(_spec)
+        try:
+            _spec.loader.exec_module(_mod)
+        except Exception:
+            pass
+        break

@@ -1,0 +1,13 @@
+"""Minimal ``torchvision`` stand-in for verbatim reference-example runs.
+
+torchvision ships CUDA-linked wheels and is not in this image; the
+reference example (reference examples/pytorch/pytorch_mnist.py:9) uses
+it only for ``datasets.MNIST`` (a *download* + decode) and three pixel
+transforms. Under zero egress the download cannot happen either way, so
+this shim provides the same surface backed by deterministic synthetic
+data. It is on PYTHONPATH only for tests/test_verbatim_examples.py.
+"""
+
+from . import datasets, transforms  # noqa: F401
+
+__version__ = "0.0.0+hvd-tpu-verbatim-shim"

@@ -1,0 +1,39 @@
+"""Synthetic ``torchvision.datasets.MNIST`` (see package docstring)."""
+
+import os
+
+import numpy as np
+
+
+class MNIST:
+    """Same constructor/len/getitem surface as torchvision's MNIST.
+
+    Images are numpy uint8 (28, 28) — the shim's ``transforms.ToTensor``
+    accepts them the way the real one accepts PIL images. 28×28 is
+    load-bearing: the reference model's fc1 expects 320 = 20·4·4
+    features after two 5×5 convs + pools (reference
+    examples/pytorch/pytorch_mnist.py:47).
+    """
+
+    def __init__(self, root, train=True, download=False, transform=None,
+                 target_transform=None):
+        self.root = root
+        self.train = train
+        self.transform = transform
+        self.target_transform = target_transform
+        n = int(os.environ.get("HVD_VERBATIM_MNIST_N", "512"))
+        n = n if train else max(n // 2, 1)
+        rng = np.random.RandomState(0 if train else 1)
+        self.data = rng.randint(0, 256, size=(n, 28, 28)).astype("uint8")
+        self.targets = rng.randint(0, 10, size=(n,)).astype("int64")
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        img, target = self.data[idx], int(self.targets[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return img, target

@@ -1,0 +1,35 @@
+"""Pixel transforms used by the reference MNIST example."""
+
+import numpy as np
+import torch
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """uint8 HxW (or HxWxC) → float32 CxHxW in [0, 1]."""
+
+    def __call__(self, pic):
+        arr = np.asarray(pic)
+        if arr.ndim == 2:
+            arr = arr[None, :, :]
+        else:
+            arr = arr.transpose(2, 0, 1)
+        return torch.from_numpy(arr.astype("float32") / 255.0)
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean = torch.tensor(mean, dtype=torch.float32).view(-1, 1, 1)
+        self.std = torch.tensor(std, dtype=torch.float32).view(-1, 1, 1)
+
+    def __call__(self, tensor):
+        return (tensor - self.mean) / self.std
