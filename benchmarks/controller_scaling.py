@@ -1,0 +1,100 @@
+"""Negotiation round latency vs world size (CPU, protocol only).
+
+VERDICT r4 weak #3: the coordinator previously issued O(size) blocking
+HTTP GETs per round; with the store's prefix-read it issues O(1). This
+harness measures the *protocol* in isolation — real processes, real
+HTTP store, no JAX — so the number is round latency, not tensor math.
+
+Per np in {2,4,8,16}: spawn np worker processes (rank 0 hosts the
+coordinator thread, exactly as in production), run R identical
+single-tensor rounds plus R SAME_AS_LAST rounds, report µs/round and
+bytes/round. Output: a markdown table + one JSON line per np.
+
+Usage: python benchmarks/controller_scaling.py [rounds]
+"""
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _worker(rank: int, nproc: int, port: int, rounds: int, q):
+    from horovod_tpu.ops.controller import KVController
+    from horovod_tpu.runner.http_server import KVStoreClient
+
+    ctl = KVController(KVStoreClient("127.0.0.1", port), rank, nproc,
+                       poll_timeout=120)
+    sig = ["allreduce", "float32", [1024], 0, -1, 1.0, 1.0, "global",
+           "host"]
+    # warmup round (store scope setup, thread starts)
+    ctl.negotiate({"warm": sig})
+
+    t0 = time.perf_counter()
+    for i in range(rounds):
+        resp = ctl.negotiate({f"t{i}": sig})
+        assert resp["ready"] == [f"t{i}"], resp
+    cold_s = time.perf_counter() - t0
+
+    # steady state: identical submission -> SAME_AS_LAST wire fast path
+    ctl.negotiate({"steady": sig})
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        resp = ctl.negotiate({"steady": sig})
+        assert resp["ready"] == ["steady"], resp
+    fast_s = time.perf_counter() - t0
+
+    if rank == 0:
+        q.put({"cold_us": cold_s / rounds * 1e6,
+               "fast_us": fast_s / rounds * 1e6,
+               "bytes_sent": ctl.bytes_sent,
+               "rounds_counted": 2 * rounds + 2,
+               "fast_rounds": ctl.fast_rounds})
+    ctl.drain_shutdown()
+    ctl.stop()
+
+
+def measure(nproc: int, rounds: int) -> dict:
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    srv = RendezvousServer()
+    port = srv.start()
+    q = mp.Queue()
+    procs = [mp.Process(target=_worker, args=(r, nproc, port, rounds, q))
+             for r in range(nproc)]
+    for p in procs:
+        p.start()
+    res = q.get(timeout=300)
+    for p in procs:
+        p.join(timeout=60)
+        if p.is_alive():
+            p.terminate()
+    srv.stop()
+    res["np"] = nproc
+    res["bytes_per_round"] = res["bytes_sent"] / res["rounds_counted"]
+    return res
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    mp.set_start_method("spawn", force=True)
+    print("| np | negotiate µs/round | steady-state µs/round "
+          "(SAME_AS_LAST) | rank-0 bytes/round |")
+    print("|---|---|---|---|")
+    rows = []
+    for nproc in (2, 4, 8, 16):
+        r = measure(nproc, rounds)
+        rows.append(r)
+        print(f"| {nproc} | {r['cold_us']:.0f} | {r['fast_us']:.0f} "
+              f"| {r['bytes_per_round']:.1f} |", flush=True)
+    for r in rows:
+        print(json.dumps({k: round(v, 2) if isinstance(v, float) else v
+                          for k, v in r.items()}))
+
+
+if __name__ == "__main__":
+    main()

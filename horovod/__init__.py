@@ -41,24 +41,34 @@ class _AliasLoader(importlib.abc.Loader):
 
     def __init__(self, real_name: str):
         self._real_name = real_name
+        self._orig_spec = None
+        self._orig_loader = None
 
     def create_module(self, spec):
-        return importlib.import_module(self._real_name)
+        module = importlib.import_module(self._real_name)
+        # the machinery is about to overwrite these with OUR spec/loader;
+        # save the genuine ones so exec_module can put them back (reload
+        # and spec-origin tooling depend on them)
+        self._orig_spec = getattr(module, "__spec__", None)
+        self._orig_loader = getattr(module, "__loader__", None)
+        return module
 
     def exec_module(self, module):
         # Already executed under its real name; restore the attributes
         # the import machinery rewrote when it adopted our spec, so the
         # module keeps identifying as horovod_tpu.* (relative imports
-        # inside it, repr, and pickling stay consistent).
+        # inside it, repr, pickling, and importlib.reload stay
+        # consistent).
         module.__name__ = self._real_name
         module.__package__ = (
             self._real_name
             if hasattr(module, "__path__")
             else self._real_name.rpartition(".")[0]
         )
-        spec = getattr(module, "__spec__", None)
-        if spec is not None and spec.name != self._real_name:
-            spec.name = self._real_name
+        if self._orig_spec is not None:
+            module.__spec__ = self._orig_spec
+        if self._orig_loader is not None:
+            module.__loader__ = self._orig_loader
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):

@@ -357,13 +357,37 @@ class _Coordinator(threading.Thread):
         start = _time.monotonic()
         warned_at = 0.0
         while missing and not self._stop_evt.is_set():
-            for k in sorted(missing):
+            # One bulk read per poll: the store blocks until all `size`
+            # submissions exist (or POLL_TIMEOUT_S passes and partial
+            # results return for stall attribution). Role of the
+            # reference's single MPI_Gatherv fan-in
+            # (mpi_controller.cc:108) — N sequential GETs per round made
+            # the coordinator O(size) HTTP round-trips per cycle.
+            bulk = getattr(self.client, "get_prefix", None)
+            if bulk is not None:
                 try:
-                    got[k] = self.client.get(_ctl_scope(r), f"ready/{k}",
-                                             timeout=self.POLL_TIMEOUT_S)
-                    missing.discard(k)
+                    for suffix, raw in bulk(
+                            _ctl_scope(r), "ready/",
+                            min_count=self.size,
+                            timeout=self.POLL_TIMEOUT_S).items():
+                        try:
+                            k = int(suffix)
+                        except ValueError:
+                            continue  # foreign key under the prefix
+                        if k in missing:
+                            got[k] = raw
+                            missing.discard(k)
                 except Exception:
-                    continue  # straggler: keep polling this rank
+                    bulk = None  # store without prefix-read support
+            if bulk is None:
+                for k in sorted(missing):
+                    try:
+                        got[k] = self.client.get(
+                            _ctl_scope(r), f"ready/{k}",
+                            timeout=self.POLL_TIMEOUT_S)
+                        missing.discard(k)
+                    except Exception:
+                        continue  # straggler: keep polling this rank
             elapsed = _time.monotonic() - start
             if missing and elapsed - warned_at > self.stall_warning_s:
                 self._warn_stall(r, missing, elapsed)

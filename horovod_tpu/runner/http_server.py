@@ -22,13 +22,13 @@ does not hold the key.
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.error import HTTPError
 from urllib.parse import unquote
-from urllib.request import Request, urlopen
 
 from . import secret as _secret
 
@@ -41,6 +41,9 @@ class KVAuthError(RuntimeError):
 
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Nagle + delayed-ACK on persistent connections costs 40 ms per
+    # response segment pair; negotiation rounds are latency-bound
+    disable_nagle_algorithm = True
 
     def log_message(self, *a):  # quiet
         pass
@@ -59,11 +62,14 @@ class _KVHandler(BaseHTTPRequestHandler):
             return False
         if skew > _secret.MAX_SKEW_SECONDS:
             return False  # stale (or far-future) signed request: replay
+        mode = ""
+        if self.headers.get("X-Prefix-Read"):
+            mode = f"prefix:{self.headers.get('X-Min-Count', '1')}"
         return _secret.check_digest(
             key, self.headers.get(_secret.DIGEST_HEADER),
             self.command.encode(), self._key().encode(),
             (self.headers.get("X-Exclude-Prefix") or "").encode(),
-            ts.encode(), body)
+            ts.encode(), mode.encode(), body)
 
     def _reject(self):
         self.send_response(403)
@@ -90,6 +96,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = self._key()
         timeout = float(self.headers.get("X-Timeout", "30"))
         deadline = time.monotonic() + timeout
+        if self.headers.get("X-Prefix-Read"):
+            return self._do_prefix_get(store, key, deadline)
         with store.cond:
             while key not in store.data:
                 remaining = deadline - time.monotonic()
@@ -106,6 +114,41 @@ class _KVHandler(BaseHTTPRequestHandler):
         if skey:
             self.send_header(_secret.DIGEST_HEADER,
                              _secret.response_digest(skey, key, body))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_prefix_get(self, store, prefix: str, deadline: float):
+        """Bulk read: every key under ``prefix`` in one request, blocking
+        until at least X-Min-Count keys exist (or the timeout passes —
+        then whatever is present returns, so the caller can attribute
+        who is missing). This is the store-side half of the
+        coordinator's O(1) round fan-in (the reference gathers ready
+        lists in one MPI_Gatherv, mpi_controller.cc:108; N sequential
+        HTTP GETs per negotiation round do not scale to pod-size
+        worlds)."""
+        import base64
+        import json
+
+        min_count = int(self.headers.get("X-Min-Count", "1"))
+        with store.cond:
+            while True:
+                matches = {k: v for k, v in store.data.items()
+                           if k.startswith(prefix)}
+                if len(matches) >= min_count:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                store.cond.wait(remaining)
+        body = json.dumps(
+            {k[len(prefix):]: base64.b64encode(v).decode()
+             for k, v in matches.items()}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        skey = self.server.secret_key  # type: ignore[attr-defined]
+        if skey:
+            self.send_header(_secret.DIGEST_HEADER,
+                             _secret.response_digest(skey, prefix, body))
         self.end_headers()
         self.wfile.write(body)
 
@@ -131,6 +174,26 @@ class _Store:
         self.cond = threading.Condition()
 
 
+class _KVServer(ThreadingHTTPServer):
+    # Every worker opens a fresh connection per request (urllib does not
+    # pool), so a world of N ranks lands ~2N near-simultaneous connects
+    # per negotiation round. The BaseServer default listen backlog of 5
+    # overflows at np≈8, costing SYN-retransmit seconds per round and
+    # connection resets at np=16 (measured, benchmarks/
+    # controller_scaling.py); a pod-scale backlog makes accept cheap.
+    request_queue_size = 1024
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]  # sys.exception() needs 3.11; we claim 3.10
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            TimeoutError)):
+            return  # peer closed its keep-alive conn (job teardown)
+        super().handle_error(request, client_address)
+
+
 class RendezvousServer:
     """Blocking-GET KV store over HTTP (reference RendezvousServer,
     http_server.py:174).
@@ -141,7 +204,7 @@ class RendezvousServer:
     single-host test use)."""
 
     def __init__(self, port: int = 0, secret_key: Optional[str] = None):
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._server = _KVServer(("0.0.0.0", port), _KVHandler)
         self._server.store = _Store()  # type: ignore[attr-defined]
         self._server.secret_key = (  # type: ignore[attr-defined]
             secret_key if secret_key is not None else _secret.env_secret())
@@ -168,57 +231,105 @@ class KVStoreClient:
     """Client for RendezvousServer (role of the C++ HTTPStore,
     gloo/http_store.cc:138). Signs requests and verifies GET responses
     when a job secret is available (same default-from-env rule as the
-    server)."""
+    server).
+
+    Connections are persistent and per-thread: a negotiation round costs
+    two requests per worker, and re-dialing TCP for each (urllib has no
+    pooling) dominated round latency at np≥8 (measured in
+    benchmarks/controller_scaling.py). A stale socket (store restart,
+    idle timeout) gets one transparent reconnect."""
 
     def __init__(self, addr: str, port: int,
                  secret_key: Optional[str] = None):
+        self.addr = addr
+        self.port = port
         self.base = f"http://{addr}:{port}"
         self._secret = (secret_key if secret_key is not None
                         else _secret.env_secret())
+        self._local = threading.local()
+
+    def _request(self, method: str, path: str, body: Optional[bytes],
+                 headers: dict, timeout: float):
+        import http.client
+
+        last_exc = None
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = http.client.HTTPConnection(self.addr, self.port,
+                                                  timeout=timeout)
+                try:
+                    conn.connect()
+                    # latency-bound request/response pairs: without
+                    # NODELAY, Nagle holds the second write segment for
+                    # the peer's delayed ACK (~40 ms per exchange,
+                    # measured in benchmarks/controller_scaling.py)
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass  # connect() retried by conn.request below
+                self._local.conn = conn
+            try:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                conn.request(method, "/" + path, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, resp.headers, data
+            except (OSError, http.client.HTTPException) as e:
+                # stale keep-alive socket: drop it and retry once on a
+                # fresh connection
+                last_exc = e
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._local.conn = None
+        raise last_exc
 
     def _headers(self, method: str, path: str, body: bytes = b"",
-                 exclude: str = "") -> dict:
+                 exclude: str = "", mode: str = "") -> dict:
         if not self._secret:
             return {}
         ts = f"{time.time():.6f}"
         return {
             _secret.TS_HEADER: ts,
             _secret.DIGEST_HEADER: _secret.request_digest(
-                self._secret, method, path, body, exclude, ts=ts),
+                self._secret, method, path, body, exclude, ts=ts,
+                mode=mode),
         }
 
-    @staticmethod
-    def _raise_on_403(e: HTTPError, what: str):
-        if e.code == 403:
+    def _check_status(self, status: int, path: str, what: str):
+        if status == 200:
+            return
+        if status == 403:
             raise KVAuthError(
                 f"KV store refused {what}: HMAC digest rejected — either "
                 "the secret key differs (is HOROVOD_SECRET_KEY consistent "
                 "across the job?) or this host's clock is more than "
                 f"{_secret.MAX_SKEW_SECONDS:.0f}s off the store's "
-                "(replay-window check; verify NTP)") from e
-        raise
+                "(replay-window check; verify NTP)")
+        # keep HTTPError for non-auth failures: callers distinguish the
+        # blocking-GET timeout (404) by exception type/code
+        raise HTTPError(f"{self.base}/{path}", status, what, None, None)
 
     def put(self, scope: str, key: str, value: bytes):
         path = f"{scope}/{key}"
-        req = Request(f"{self.base}/{path}", data=value, method="PUT",
-                      headers=self._headers("PUT", path, value))
-        try:
-            urlopen(req, timeout=30).read()
-        except HTTPError as e:
-            self._raise_on_403(e, f"PUT {path}")
+        status, _, _ = self._request(
+            "PUT", path, value, self._headers("PUT", path, value), 30.0)
+        self._check_status(status, path, f"PUT {path}")
 
     def get(self, scope: str, key: str, timeout: float = 30.0) -> bytes:
         path = f"{scope}/{key}"
         headers = {"X-Timeout": str(timeout)}
         headers.update(self._headers("GET", path))
-        req = Request(f"{self.base}/{path}", method="GET", headers=headers)
-        try:
-            resp = urlopen(req, timeout=timeout + 10)
-        except HTTPError as e:
-            self._raise_on_403(e, f"GET {path}")
-        body = resp.read()
+        status, rhdrs, body = self._request("GET", path, None, headers,
+                                            timeout + 10)
+        self._check_status(status, path, f"GET {path}")
         if self._secret and not _secret.check_digest(
-                self._secret, resp.headers.get(_secret.DIGEST_HEADER),
+                self._secret, rhdrs.get(_secret.DIGEST_HEADER),
                 b"RESP", path.encode(), body):
             raise KVAuthError(
                 f"GET {path}: response digest missing or invalid — the "
@@ -226,14 +337,37 @@ class KVStoreClient:
                 "hold the job secret")
         return body
 
+    def get_prefix(self, scope: str, prefix: str = "", min_count: int = 1,
+                   timeout: float = 30.0) -> dict:
+        """Bulk read of every key under ``scope/prefix`` in ONE request,
+        blocking server-side until ``min_count`` keys exist or the
+        timeout passes (partial results return then). Returns
+        {key_suffix: bytes}. The coordinator's per-round fan-in rides
+        this (role of MPI_Gatherv, reference mpi_controller.cc:108)."""
+        import base64
+        import json
+
+        path = f"{scope}/{prefix}"
+        mode = f"prefix:{min_count}"
+        headers = {"X-Prefix-Read": "1", "X-Min-Count": str(min_count),
+                   "X-Timeout": str(timeout)}
+        headers.update(self._headers("GET", path, mode=mode))
+        status, rhdrs, body = self._request("GET", path, None, headers,
+                                            timeout + 10)
+        self._check_status(status, path, f"GET(prefix) {path}")
+        if self._secret and not _secret.check_digest(
+                self._secret, rhdrs.get(_secret.DIGEST_HEADER),
+                b"RESP", path.encode(), body):
+            raise KVAuthError(
+                f"GET(prefix) {path}: response digest missing or invalid")
+        return {k: base64.b64decode(v)
+                for k, v in json.loads(body).items()}
+
     def delete_scope(self, scope: str):
         path = f"{scope}/"
-        req = Request(f"{self.base}/{path}", method="DELETE",
-                      headers=self._headers("DELETE", path))
-        try:
-            urlopen(req, timeout=30).read()
-        except HTTPError as e:
-            self._raise_on_403(e, f"DELETE {path}")
+        status, _, _ = self._request(
+            "DELETE", path, None, self._headers("DELETE", path), 30.0)
+        self._check_status(status, path, f"DELETE {path}")
 
     def delete_prefix(self, prefix: str, exclude: Optional[str] = None):
         """Delete every key under ``prefix`` except those under
@@ -242,9 +376,5 @@ class KVStoreClient:
         headers = self._headers("DELETE", prefix, exclude=exclude or "")
         if exclude:
             headers["X-Exclude-Prefix"] = exclude
-        req = Request(f"{self.base}/{prefix}", method="DELETE",
-                      headers=headers)
-        try:
-            urlopen(req, timeout=30).read()
-        except HTTPError as e:
-            self._raise_on_403(e, f"DELETE {prefix}")
+        status, _, _ = self._request("DELETE", prefix, None, headers, 30.0)
+        self._check_status(status, prefix, f"DELETE {prefix}")

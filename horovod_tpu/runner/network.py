@@ -25,45 +25,62 @@ LOG = logging.getLogger("horovod_tpu")
 LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
 
 
-import functools
+_identity_cache: Optional[tuple] = None
+_is_local_cache: dict = {}
 
 
-@functools.lru_cache(maxsize=1)
-def _local_identity() -> tuple[frozenset, frozenset]:
-    """(own names, own addresses) — computed once per process: the
-    launcher and the elastic driver call is_local_host in per-slot loops
-    every (re)discovery cycle, and blocking DNS work there multiplies."""
+def _local_identity() -> tuple:
+    """(own names, own addresses) — cached once per process on SUCCESS:
+    the launcher and the elastic driver call is_local_host in per-slot
+    loops every (re)discovery cycle, and blocking DNS work there
+    multiplies. A transient resolution failure is NOT cached (early-boot
+    DNS would otherwise poison the whole process lifetime)."""
+    global _identity_cache
+    if _identity_cache is not None:
+        return _identity_cache
+    ok = True
     names = {socket.gethostname()}
     try:
         names.add(socket.getfqdn())
     except OSError:
-        pass
+        ok = False
     addrs = {"127.0.0.1", "::1"}
     try:
         addrs.update(ai[4][0] for ai in socket.getaddrinfo(
             socket.gethostname(), None))
     except OSError:
-        pass
-    return frozenset(names), frozenset(addrs)
+        ok = False
+    result = (frozenset(names), frozenset(addrs))
+    if ok:
+        _identity_cache = result
+    return result
 
 
-@functools.lru_cache(maxsize=256)
 def is_local_host(hostname: str) -> bool:
     """True when ``hostname`` names this machine — shortname, FQDN, or a
     loopback literal. Matching the FQDN matters operationally: a
     ``-H <local-fqdn>:N`` job must exec its slots directly, not SSH to
-    itself (and must not run the remote route probe at all)."""
+    itself (and must not run the remote route probe at all). Verdicts
+    are memoized per process, except ones derived from a failed DNS
+    lookup (transient — must stay retryable)."""
     if hostname in LOCAL_NAMES:
         return True
+    cached = _is_local_cache.get(hostname)
+    if cached is not None:
+        return cached
     names, local_addrs = _local_identity()
     if hostname in names:
+        _is_local_cache[hostname] = True
         return True
     try:
         # last resort: does the name resolve to one of our own addresses?
         addrs = {ai[4][0] for ai in socket.getaddrinfo(hostname, None)}
     except OSError:
-        return False
-    return bool(addrs & local_addrs)
+        return False  # transient failure: do not cache
+    verdict = bool(addrs & local_addrs)
+    if len(_is_local_cache) < 4096:
+        _is_local_cache[hostname] = verdict
+    return verdict
 
 
 def interface_address(ifname: str) -> str:

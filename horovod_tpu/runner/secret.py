@@ -80,15 +80,17 @@ def check_digest(key: str, digest: str | None, *parts: bytes) -> bool:
 
 
 def request_digest(key: str, method: str, path: str, body: bytes = b"",
-                   exclude: str = "", ts: str = "") -> str:
+                   exclude: str = "", ts: str = "", mode: str = "") -> str:
     """Digest for a KV request. ``exclude`` is the DELETE sweep's
-    X-Exclude-Prefix header — it changes what the request does, so it is
-    part of the signed material. ``ts`` is the sender's clock
+    X-Exclude-Prefix header and ``mode`` the GET prefix-read marker
+    (``prefix:<min_count>``) — they change what the request does, so
+    they are part of the signed material. ``ts`` is the sender's clock
     (X-HVD-TS): signing it gives requests freshness, so a sniffed
     request replays for at most MAX_SKEW_SECONDS (the reference's
     pickled-TCP HMAC scheme has no freshness at all)."""
     return compute_digest(key, method.encode(), path.encode(),
-                          exclude.encode(), ts.encode(), body)
+                          exclude.encode(), ts.encode(), mode.encode(),
+                          body)
 
 
 def response_digest(key: str, path: str, body: bytes) -> str:
