@@ -80,6 +80,10 @@ from .ops.collectives import (  # noqa: F401
     join,
     reducescatter,
 )
+from .ops.adasum import (  # noqa: F401
+    adasum_allreduce,
+    adasum_allreduce_hierarchical,
+)
 from .ops.compression import Compression  # noqa: F401
 from .ops.queue import TensorEntry
 

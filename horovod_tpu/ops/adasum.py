@@ -23,11 +23,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def adasum_combine(a, b):
+def adasum_combine(a, b, norm_axis: str | None = None):
     """Combine two same-shaped gradient tensors with the Adasum rule.
 
     Computed in float32 for stability (reference uses double accumulators
     for fp16 inputs, adasum.h AVX F16C paths), cast back to input dtype.
+
+    ``norm_axis``: when ``a``/``b`` are *chunks* of a vector scattered
+    over a mesh axis, the dot products and norms must describe the FULL
+    vector for the combine coefficients to match unchunked Adasum — so
+    the three scalars are psummed over that axis before use (exactly the
+    reference's fused scheme: local partial dots + an allreduce of the
+    double[3], adasum.h DotProdImpl / adasum_mpi.cc).
     """
     dt = a.dtype
     af = a.astype(jnp.float32)
@@ -35,13 +42,15 @@ def adasum_combine(a, b):
     dot = jnp.vdot(af, bf)
     na2 = jnp.vdot(af, af)
     nb2 = jnp.vdot(bf, bf)
+    if norm_axis is not None:
+        dot, na2, nb2 = lax.psum(jnp.stack([dot, na2, nb2]), norm_axis)
     # zero-norm edges: if a == 0 result is b, and vice versa
     acoef = jnp.where(na2 > 0, 1.0 - dot / (2.0 * jnp.where(na2 > 0, na2, 1.0)), 0.0)
     bcoef = jnp.where(nb2 > 0, 1.0 - dot / (2.0 * jnp.where(nb2 > 0, nb2, 1.0)), 0.0)
     return (acoef * af + bcoef * bf).astype(dt)
 
 
-def adasum_allreduce(x, axis_name: str):
+def adasum_allreduce(x, axis_name: str, norm_axis: str | None = None):
     """Traced Adasum allreduce over a mesh axis (power-of-2 size).
 
     Hypercube distance-doubling: round k exchanges with partner
@@ -49,6 +58,9 @@ def adasum_allreduce(x, axis_name: str):
     partners converge to the same value — after log2(n) rounds every chip
     holds the full Adasum reduction (replaces adasum.h:161 recursion +
     MPI_Send/Recv with XLA collectives).
+
+    ``norm_axis``: see adasum_combine — set when ``x`` is a chunk of a
+    vector scattered over that other axis (the hierarchical path).
     """
     n = lax.axis_size(axis_name)
     if n & (n - 1):
@@ -57,13 +69,39 @@ def adasum_allreduce(x, axis_name: str):
     while k < n:
         perm = [(i, i ^ k) for i in range(n)]
         other = lax.ppermute(x, axis_name, perm)
-        x = adasum_combine(x, other)
+        x = adasum_combine(x, other, norm_axis=norm_axis)
         k *= 2
     # All chips now hold the identical reduction, but ppermute outputs are
     # typed as device-varying; the closing pmean of identical values is a
     # no-op numerically and re-types the result as replicated so it can
     # cross shard_map boundaries with out_specs=P().
     return lax.pmean(x, axis_name)
+
+
+def adasum_allreduce_hierarchical(x, local_axis: str, cross_axis: str):
+    """Two-level Adasum over the mesh triad (reference
+    adasum_gpu_operations.cc:1-319: NCCL ReduceScatter within the node →
+    Adasum across nodes on the scattered chunks → NCCL Allgather).
+
+    TPU mapping: mean + scatter over the ICI-local axis
+    (``psum_scatter / n_local`` — local contributions average, like the
+    reference's LR-scaling contract that treats the node as one
+    logical contributor), then the cross-axis hypercube runs on 1/n_local
+    chunks with the dot/norm scalars psummed over the local axis — so the
+    combine coefficients describe the full vectors and the result equals
+    unchunked Adasum of the local means, while cross-axis (DCN) traffic
+    per chip drops by n_local. The closing all_gather is the local
+    broadcast.
+    """
+    nl = lax.axis_size(local_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.size) % nl
+    padded = jnp.pad(flat, (0, pad))
+    chunk = lax.psum_scatter(padded, local_axis, scatter_dimension=0,
+                             tiled=True) / nl
+    red = adasum_allreduce(chunk, cross_axis, norm_axis=local_axis)
+    full = lax.all_gather(red, local_axis, tiled=True)
+    return full[:flat.size].reshape(x.shape)
 
 
 def adasum_tree_reduce(g):

@@ -260,9 +260,11 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
         return jnp.asarray(out)
 
     hier = (_hierarchical_enabled("allreduce")
-            and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM)
             and ps.mesh_2d is not None
-            and ps.mesh_2d.shape[LOCAL_AXIS] > 1)
+            and ps.mesh_2d.shape[LOCAL_AXIS] > 1
+            # the cross-axis hypercube needs a power-of-2 world
+            and not (op == ReduceOp.ADASUM and (nproc & (nproc - 1))))
     key = ("allreduce", ps.name, xl.shape, str(xl.dtype), int(op),
            float(prescale_factor), float(postscale_factor), hier)
 
@@ -312,9 +314,22 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
             chunk = lax.dynamic_slice(padded, (li * csz,), (csz,))
             if prescale_factor != 1.0:
                 chunk = chunk * prescale_factor
-            red = lax.psum(chunk, PROC_AXIS)
-            if op == ReduceOp.AVERAGE:
-                red = red / ps.cross_size
+            if op == ReduceOp.ADASUM:
+                # two-level Adasum (reference adasum_gpu_operations.cc):
+                # each local chip already holds a 1/nl chunk of this
+                # process's contribution; the cross-process hypercube
+                # runs on chunks with dot/norm scalars psummed over the
+                # local axis, so coefficients describe the full vectors
+                # and the result EQUALS flat Adasum — with cross (DCN)
+                # traffic per chip divided by nl
+                from .adasum import adasum_allreduce
+
+                red = adasum_allreduce(chunk, PROC_AXIS,
+                                       norm_axis=LOCAL_AXIS)
+            else:
+                red = lax.psum(chunk, PROC_AXIS)
+                if op == ReduceOp.AVERAGE:
+                    red = red / ps.cross_size
             if postscale_factor != 1.0:
                 red = red * postscale_factor
             full = _traced_allgather(red[None], LOCAL_AXIS)

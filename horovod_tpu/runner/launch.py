@@ -33,7 +33,8 @@ import time
 from typing import Optional
 
 from ..common import env as env_schema
-from .hosts import HostInfo, SlotInfo, get_host_assignments, parse_hostfile, parse_hosts
+from .hosts import (HostInfo, SlotInfo, get_host_assignments,
+                    hosts_from_allocation, parse_hostfile, parse_hosts)
 from .http_server import RendezvousServer
 
 
@@ -230,10 +231,17 @@ def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu job (horovodrun equivalent).")
-    p.add_argument("-np", "--num-proc", type=int, default=1)
+    p.add_argument("-np", "--num-proc", type=int, default=None)
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default: localhost:np)")
     p.add_argument("--hostfile", default=None)
+    p.add_argument("--from-allocation", action="store_true",
+                   help="derive the host list from the scheduler "
+                        "allocation's environment (LSB_DJOB_HOSTFILE / "
+                        "LSB_MCPU_HOSTS / LSB_HOSTS / "
+                        "SLURM_JOB_NODELIST+SLURM_TASKS_PER_NODE; "
+                        "reference jsrun/LSF path, runner/js_run.py). "
+                        "-np defaults to every allocated slot")
     p.add_argument("-p", "--ssh-port", type=int, default=None)
     p.add_argument("-i", "--ssh-identity-file", default=None)
     p.add_argument("--env", action="append", default=[],
@@ -396,14 +404,27 @@ def run_commandline(argv=None) -> int:
     if args.host_discovery_script or args.min_np or args.max_np:
         from ..elastic.driver import run_elastic
 
+        if args.num_proc is None:
+            args.num_proc = 1
         return run_elastic(command, args)
 
-    if args.hostfile:
+    if args.from_allocation:
+        try:
+            hosts = hosts_from_allocation(os.environ)
+        except (ValueError, OSError) as e:
+            print(f"hvdrun: {e}", file=sys.stderr)
+            return 2
+        if args.num_proc is None:
+            args.num_proc = sum(h.slots for h in hosts)
+    elif args.hostfile:
         hosts = parse_hostfile(args.hostfile)
     elif args.hosts:
         hosts = parse_hosts(args.hosts)
     else:
-        hosts = [HostInfo("localhost", args.num_proc)]
+        hosts = [HostInfo("localhost", args.num_proc or 1)]
+    if args.num_proc is None:
+        args.num_proc = sum(h.slots for h in hosts) if args.hosts \
+            or args.hostfile else 1
     try:
         slots = get_host_assignments(hosts, args.num_proc)
     except ValueError as e:

@@ -19,7 +19,9 @@ class KerasEstimator:
                  batch_size: int = 32, epochs: int = 1,
                  feature_cols=None, label_cols=None, run_id: str = "run0",
                  verbose: int = 1, backend_env: Optional[dict] = None,
-                 label_dtype=None, staging_chunk_rows: int = 4096):
+                 label_dtype=None, staging_chunk_rows: int = 4096,
+                 validation: Optional[float] = None,
+                 resume_from_checkpoint: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -38,21 +40,40 @@ class KerasEstimator:
         self.label_dtype = label_dtype
         # rows per staged npz chunk on the store-backed data path
         self.staging_chunk_rows = staging_chunk_rows
+        # fraction of rows held out for per-epoch validation (reference
+        # keras estimator validation param)
+        self.validation = validation
+        # continue a killed run from its last per-epoch checkpoint
+        # (reference keras/remote.py restores the checkpoint and resumes
+        # at initial_epoch)
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.history: dict = {}
+        self._best_score = float("inf")  # best monitored loss so far
 
     def checkpoint_path(self) -> str:
         if self.store is None:
             raise ValueError("estimator needs a store for checkpoints")
         return self.store.get_checkpoint_path(self.run_id)
 
-    def save_checkpoint(self):
-        """Serialize the Keras model into the store (rank-0 convention)."""
+    def best_checkpoint_path(self) -> str:
+        return self.checkpoint_path() + ".best"
+
+    def _meta_path(self) -> str:
+        return self.checkpoint_path() + ".meta"
+
+    def save_checkpoint(self, epoch: Optional[int] = None,
+                        path: Optional[str] = None):
+        """Serialize the Keras model into the store (rank-0 convention;
+        reference keras/remote.py writes the checkpoint every epoch). The
+        ``.keras`` archive carries optimizer state, so a resumed fit
+        continues the same optimizer trajectory; epoch + history ride a
+        JSON sidecar."""
         import io
+        import json
 
         if self.model is None:
             raise ValueError("no model to checkpoint")
         buf = io.BytesIO()
-        import keras
-
         # keras 3 saves to a file path; round-trip through a temp file
         import os
         import tempfile
@@ -62,20 +83,64 @@ class KerasEstimator:
             self.model.save(p)
             with open(p, "rb") as f:
                 buf.write(f.read())
-        self.store.write_bytes(self.checkpoint_path(), buf.getvalue())
+        self.store.write_bytes(path or self.checkpoint_path(),
+                               buf.getvalue())
+        if epoch is not None and path is None:
+            self.store.write_bytes(self._meta_path(), json.dumps(
+                {"epoch": epoch, "history": self.history,
+                 "best": self._best_score}).encode())
 
-    def load_checkpoint(self):
+    def load_checkpoint(self, best: bool = False):
+        """Restore the model from the store; returns the model. The epoch
+        to resume FROM lands in ``self._resume_epoch``."""
+        import json
         import os
         import tempfile
 
         import keras
 
-        data = self.store.read_bytes(self.checkpoint_path())
+        path = self.best_checkpoint_path() if best else self.checkpoint_path()
+        data = self.store.read_bytes(path)
+        self._resume_epoch = 0
+        if not best and self.store.exists(self._meta_path()):
+            meta = json.loads(self.store.read_bytes(self._meta_path()))
+            self._resume_epoch = int(meta.get("epoch", -1)) + 1
+            self.history = dict(meta.get("history") or {})
+            if meta.get("best") is not None:
+                # the pre-crash best survives the resume: a worse first
+                # post-resume epoch must NOT overwrite the .best model
+                self._best_score = float(meta["best"])
         with tempfile.TemporaryDirectory() as d:
             p = os.path.join(d, "model.keras")
             with open(p, "wb") as f:
                 f.write(data)
             return keras.models.load_model(p)
+
+    def _store_callbacks(self, hvd_keras=None, distributed=False) -> list:
+        """Per-epoch checkpoint + best-model tracking as a Keras callback
+        (reference remote.py: rank 0 saves after every epoch)."""
+        if self.store is None:
+            return []
+        if distributed and hvd_keras.cross_rank() != 0:
+            return []
+        import keras
+
+        est = self
+
+        class _StoreCheckpoint(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                logs = logs or {}
+                for k, v in logs.items():
+                    est.history.setdefault(k, []).append(float(v))
+                score = logs.get("val_loss", logs.get("loss"))
+                # est._best_score persists through resume (meta sidecar),
+                # so a worse post-resume epoch keeps the pre-crash best
+                if score is not None and float(score) <= est._best_score:
+                    est._best_score = float(score)
+                    est.save_checkpoint(path=est.best_checkpoint_path())
+                est.save_checkpoint(epoch=epoch)
+
+        return [_StoreCheckpoint()]
 
     def fit(self, df):
         """Train on a pandas or pyspark DataFrame (reference estimator.fit
@@ -125,18 +190,26 @@ class KerasEstimator:
             if not hvd_keras.is_initialized():
                 hvd_keras.init()
             distributed = hvd_keras.cross_size() > 1
+        # (no store handling here: fit() dispatched to _fit_from_store
+        # above whenever a store is present, and that path owns
+        # checkpointing + resume)
+        self.history = {}
         callbacks = []
         if distributed:
             self._compile_distributed(hvd_keras)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             x, y = x[r::n], y[r::n]
             callbacks = [
-                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
-        self.model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
-                       callbacks=callbacks, verbose=self.verbose)
-        # (no checkpoint here: store-backed fits return via _fit_from_store,
-        # which owns checkpointing; the in-memory path has no store)
-        return KerasModel(self.model, self.feature_cols)
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                hvd_keras.callbacks.MetricAverageCallback()]
+        hist = self.model.fit(
+            x, y, batch_size=self.batch_size, epochs=self.epochs,
+            validation_split=float(self.validation or 0.0),
+            callbacks=callbacks, verbose=self.verbose)
+        self.history = {k: [float(v) for v in vs]
+                        for k, vs in hist.history.items()}
+        return KerasModel(self.model, self.feature_cols,
+                          history=self.history)
 
     def _compile_distributed(self, hvd_keras):
         """Wrap the model's compiled optimizer for gradient allreduce,
@@ -183,19 +256,40 @@ class KerasEstimator:
 
         import horovod_tpu.keras as hvd_keras
 
+        from .common.datamodule import load_meta
+
         distributed = False
-        callbacks = []
         if "HOROVOD_RANK" in os.environ:
             if not hvd_keras.is_initialized():
                 hvd_keras.init()
             distributed = hvd_keras.cross_size() > 1
         r = hvd_keras.cross_rank() if distributed else 0
         n = hvd_keras.cross_size() if distributed else 1
+        self.history = {}
+        initial_epoch = 0
+        if (self.resume_from_checkpoint
+                and self.store.exists(self.checkpoint_path())):
+            self.model = self.load_checkpoint()
+            initial_epoch = self._resume_epoch
+        callbacks = []
         if distributed:
             self._compile_distributed(hvd_keras)
             callbacks = [
-                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
-        ds = StoreDataset(self.store, train_path, shard_id=r, num_shards=n)
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                hvd_keras.callbacks.MetricAverageCallback()]
+        # validation reserves whole tail chunks (same scheme as the torch
+        # estimator's store path)
+        n_chunks = load_meta(self.store, train_path)["n_chunks"]
+        n_val = 0
+        if self.validation:
+            if n_chunks < 2:
+                raise ValueError(
+                    "validation split on the store path reserves whole "
+                    "chunks; stage at least 2 (lower staging_chunk_rows)")
+            n_val = max(1, round(float(self.validation) * n_chunks))
+            n_val = min(n_val, n_chunks - 1)
+        ds = StoreDataset(self.store, train_path, shard_id=r, num_shards=n,
+                          chunks=list(range(n_chunks - n_val)))
         self.last_train_dataset = ds  # observability for streaming tests
         steps = (ds.min_shard_batches(self.batch_size) if distributed
                  else ds.shard_batches(self.batch_size))
@@ -211,11 +305,44 @@ class KerasEstimator:
                     yield xb, yb
                 epoch += 1
 
+        fit_kwargs = {}
+        if n_val:
+            # validation shards across ranks too (MetricAverageCallback
+            # averages the shard means); vsteps uses the min shard so
+            # every rank runs the same count
+            val_ds = StoreDataset(
+                self.store, train_path, shard_id=r, num_shards=n,
+                chunks=list(range(n_chunks - n_val, n_chunks)))
+            vsteps = (val_ds.min_shard_batches(self.batch_size)
+                      if distributed
+                      else val_ds.shard_batches(self.batch_size))
+            if distributed and vsteps < 1:
+                # a rank's val shard would be empty: every rank must run
+                # the same validation graph (the metric-average callback
+                # allreduces per metric), so fall back to the full set
+                val_ds = StoreDataset(
+                    self.store, train_path, shard_id=0, num_shards=1,
+                    chunks=list(range(n_chunks - n_val, n_chunks)))
+                vsteps = val_ds.shard_batches(self.batch_size)
+
+            def vgen():
+                while True:
+                    for xb, yb in val_ds.batches(self.batch_size,
+                                                 limit=max(vsteps, 1)):
+                        yield xb, yb
+
+            fit_kwargs = {"validation_data": vgen(),
+                          "validation_steps": max(vsteps, 1)}
+
+        callbacks += self._store_callbacks(hvd_keras, distributed)
         self.model.fit(gen(), steps_per_epoch=steps, epochs=self.epochs,
-                       callbacks=callbacks, verbose=self.verbose)
+                       initial_epoch=initial_epoch, callbacks=callbacks,
+                       verbose=self.verbose, **fit_kwargs)
         if not distributed or hvd_keras.cross_rank() == 0:
-            self.save_checkpoint()
-        return KerasModel(self.model, self.feature_cols)
+            if not self.store.exists(self.checkpoint_path()):
+                self.save_checkpoint()  # zero-new-epoch resumes included
+        return KerasModel(self.model, self.feature_cols,
+                          history=self.history)
 
     def _fit_multiproc_store(self) -> "KerasModel":
         """num_proc workers stream their own store shards; only the model
@@ -237,7 +364,9 @@ class KerasEstimator:
             feature_cols=self.feature_cols, label_cols=self.label_cols,
             run_id=self.run_id, verbose=self.verbose,
             label_dtype=self.label_dtype,
-            staging_chunk_rows=self.staging_chunk_rows)
+            staging_chunk_rows=self.staging_chunk_rows,
+            validation=self.validation,
+            resume_from_checkpoint=self.resume_from_checkpoint)
         store = self.store
 
         def worker(model_bytes, store, params):
@@ -257,7 +386,7 @@ class KerasEstimator:
             est = KerasEstimator(model=model, store=store, **params)
             est.fit(None)  # store path: reuses the staged chunks
             if hvd_keras.cross_rank() == 0:
-                return model.get_weights()
+                return est.model.get_weights(), est.history
             return None
 
         settings = ElasticFunctionExecutor.create_settings(
@@ -270,9 +399,10 @@ class KerasEstimator:
             results = ex.run(worker, args=(model_bytes, store, params))
         finally:
             ex.shutdown()
-        weights = next(r for r in results if r is not None)
+        weights, self.history = next(r for r in results if r is not None)
         self.model.set_weights(weights)
-        return KerasModel(self.model, self.feature_cols)
+        return KerasModel(self.model, self.feature_cols,
+                          history=self.history)
 
     def _fit_multiproc(self, x, y):
         """Launch ``num_proc`` worker processes (reference
@@ -293,7 +423,8 @@ class KerasEstimator:
             with open(p, "rb") as f:
                 model_bytes = f.read()
         cfg = dict(batch_size=self.batch_size, epochs=self.epochs,
-                   verbose=self.verbose)
+                   verbose=self.verbose,
+                   validation=float(self.validation or 0.0))
 
         def worker(model_bytes, x, y, cfg):
             import os
@@ -313,12 +444,16 @@ class KerasEstimator:
                 model = hvd_keras.load_model(p)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             callbacks = [
-                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)]
-            model.fit(x[r::n], y[r::n], batch_size=cfg["batch_size"],
-                      epochs=cfg["epochs"], callbacks=callbacks,
-                      verbose=cfg["verbose"] if r == 0 else 0)
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                hvd_keras.callbacks.MetricAverageCallback()]
+            hist = model.fit(x[r::n], y[r::n], batch_size=cfg["batch_size"],
+                             epochs=cfg["epochs"], callbacks=callbacks,
+                             validation_split=cfg["validation"],
+                             verbose=cfg["verbose"] if r == 0 else 0)
             if r == 0:
-                return model.get_weights()
+                return model.get_weights(), {
+                    k: [float(v) for v in vs]
+                    for k, vs in hist.history.items()}
             return None
 
         settings = ElasticFunctionExecutor.create_settings(
@@ -331,21 +466,29 @@ class KerasEstimator:
             results = ex.run(worker, args=(model_bytes, x, y, cfg))
         finally:
             ex.shutdown()
-        weights = next(r for r in results if r is not None)
+        weights, self.history = next(r for r in results if r is not None)
         self.model.set_weights(weights)
         if self.store is not None:
             self.save_checkpoint()
-        return KerasModel(self.model, self.feature_cols)
+        return KerasModel(self.model, self.feature_cols,
+                          history=self.history)
 
 
 class KerasModel:
     """Transformer returned by ``fit`` (reference spark/keras/estimator.py
-    KerasModel): appends prediction columns to the DataFrame."""
+    KerasModel): appends prediction columns to the DataFrame. Carries the
+    training ``history`` (dict of per-epoch metric lists, Keras History
+    shape — reference KerasModel.getHistory)."""
 
-    def __init__(self, model, feature_cols, output_cols=("prediction",)):
+    def __init__(self, model, feature_cols, output_cols=("prediction",),
+                 history: Optional[dict] = None):
         self.model = model
         self.feature_cols = list(feature_cols)
         self.output_cols = list(output_cols)
+        self.history = dict(history or {})
+
+    def getHistory(self) -> dict:
+        return self.history
 
     def transform(self, df):
         import numpy as np

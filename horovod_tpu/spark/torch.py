@@ -22,13 +22,21 @@ from .common.util import dataframe_to_numpy, train_val_split
 class TorchModel:
     """Transformer returned by ``TorchEstimator.fit`` (reference
     spark/torch/estimator.py TorchModel): applies the trained model to a
-    DataFrame, appending output columns."""
+    DataFrame, appending output columns. Carries the per-epoch training
+    ``history`` (reference remote.py:365-380: a list of
+    ``{'epoch': e, 'train': {...}, 'validation': {...}}`` dicts)."""
 
     def __init__(self, model, feature_cols: Sequence[str],
-                 output_cols: Sequence[str] = ("prediction",)):
+                 output_cols: Sequence[str] = ("prediction",),
+                 history: Optional[list] = None):
         self.model = model
         self.feature_cols = list(feature_cols)
         self.output_cols = list(output_cols)
+        self.history = list(history or [])
+
+    def getHistory(self) -> list:
+        """Reference TorchModel.getHistory camelCase surface."""
+        return self.history
 
     def transform(self, df):
         import torch
@@ -57,7 +65,9 @@ class TorchEstimator:
                  store: Optional[Store] = None, run_id: str = "run0",
                  backward_passes_per_step: int = 1, verbose: int = 1,
                  backend_env: Optional[dict] = None,
-                 label_dtype=None, staging_chunk_rows: int = 4096):
+                 label_dtype=None, staging_chunk_rows: int = 4096,
+                 metrics: Optional[dict] = None,
+                 resume_from_checkpoint: bool = False):
         self.num_proc = num_proc
         self.model = model
         self.optimizer = optimizer  # instance or factory(params)->optimizer
@@ -77,6 +87,14 @@ class TorchEstimator:
         self.label_dtype = label_dtype
         # rows per staged npz chunk on the store-backed data path
         self.staging_chunk_rows = staging_chunk_rows
+        # {name: fn(outputs, labels) -> scalar} evaluated per batch and
+        # averaged per epoch (reference remote.py metric_fn_groups)
+        self.metrics = dict(metrics or {})
+        # continue a killed run from its last per-epoch checkpoint
+        # (reference estimator_params resume_from_checkpoint +
+        # remote.py:141-143 state restore)
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.history: list = []
 
     # -- checkpoints (Store-backed, reference spark/common/store.py) --------
     def checkpoint_path(self) -> str:
@@ -84,18 +102,42 @@ class TorchEstimator:
             raise ValueError("estimator needs a store for checkpoints")
         return self.store.get_checkpoint_path(self.run_id)
 
-    def save_checkpoint(self):
+    def best_checkpoint_path(self) -> str:
+        return self.checkpoint_path() + ".best"
+
+    def save_checkpoint(self, optimizer=None, epoch: Optional[int] = None,
+                        path: Optional[str] = None):
+        """Full training state per epoch (reference remote.py
+        save_checkpoint: model + optimizer written every epoch by rank 0),
+        plus the history so a resumed fit returns the COMPLETE history."""
         import torch
 
+        state = {"model": self.model.state_dict(),
+                 "optimizer": optimizer.state_dict() if optimizer else None,
+                 "epoch": epoch, "history": self.history}
         buf = io.BytesIO()
-        torch.save(self.model.state_dict(), buf)
-        self.store.write_bytes(self.checkpoint_path(), buf.getvalue())
+        torch.save(state, buf)
+        self.store.write_bytes(path or self.checkpoint_path(),
+                               buf.getvalue())
 
-    def load_checkpoint(self):
+    def load_checkpoint(self, optimizer=None, best: bool = False):
+        """Restore model (+ optimizer when given); returns the model.
+        The epoch to resume FROM lands in ``self._resume_epoch`` (0 when
+        the checkpoint predates the full-state format)."""
         import torch
 
-        data = self.store.read_bytes(self.checkpoint_path())
-        self.model.load_state_dict(torch.load(io.BytesIO(data)))
+        path = self.best_checkpoint_path() if best else self.checkpoint_path()
+        data = torch.load(io.BytesIO(self.store.read_bytes(path)))
+        self._resume_epoch = 0
+        if not isinstance(data, dict) or "model" not in data:
+            self.model.load_state_dict(data)  # legacy raw state_dict
+            return self.model
+        self.model.load_state_dict(data["model"])
+        if optimizer is not None and data.get("optimizer") is not None:
+            optimizer.load_state_dict(data["optimizer"])
+        self.history = list(data.get("history") or [])
+        ep = data.get("epoch")
+        self._resume_epoch = 0 if ep is None else int(ep) + 1
         return self.model
 
     # -- training -----------------------------------------------------------
@@ -107,6 +149,110 @@ class TorchEstimator:
         if isinstance(self.optimizer, torch.optim.Optimizer):
             return self.optimizer
         return self.optimizer(self.model.parameters())
+
+    def _avg_scalar(self, value_sum: float, count: int, name: str,
+                    distributed: bool, hvd_torch) -> float:
+        """Per-epoch metric average across ranks (role of reference
+        remote.py metric_cls' allreduce): a weighted (sum, count) pair
+        rides ONE sum-allreduce, so ranks with unequal batch counts —
+        including an empty validation shard — contribute exactly their
+        weight."""
+        import torch
+
+        if not distributed:
+            return float(value_sum / count) if count else 0.0
+        pair = hvd_torch.allreduce(
+            torch.tensor([float(value_sum), float(count)]),
+            name=f"est.metric.{name}", op=hvd_torch.Sum)
+        return float(pair[0] / pair[1]) if float(pair[1]) else 0.0
+
+    def _epoch_loop(self, opt, train_batches, val_batches, distributed,
+                    hvd_torch, raw_opt=None) -> list:
+        """Reference spark/torch/remote.py:313-385 loop shape: per epoch —
+        train pass (loss + user metrics, rank-averaged), validation pass,
+        history append, rank-0 per-epoch checkpoint with best-model
+        tracking, and resume from the last checkpoint when asked.
+
+        ``train_batches(epoch)`` / ``val_batches()`` yield (xb, yb) torch
+        tensors; ``raw_opt`` is the unwrapped optimizer whose state_dict
+        rides the checkpoint (the Distributed wrapper shares it).
+        """
+        import logging
+
+        import torch
+
+        log = logging.getLogger("horovod_tpu")
+        rank0 = (not distributed) or hvd_torch.cross_rank() == 0
+        start_epoch = 0
+        self.history = []
+        ckpt_opt = raw_opt if raw_opt is not None else opt
+        if (self.resume_from_checkpoint and self.store is not None
+                and self.store.exists(self.checkpoint_path())):
+            self.load_checkpoint(optimizer=ckpt_opt)
+            start_epoch = self._resume_epoch
+            if self.verbose and rank0:
+                log.info("TorchEstimator resuming run %s from epoch %d",
+                         self.run_id, start_epoch)
+        if distributed:
+            # resume included: rank 0's restored weights win everywhere
+            hvd_torch.broadcast_parameters(self.model.state_dict(),
+                                           root_rank=0)
+        best_val = min(
+            (h.get("validation", {}).get("loss", float("inf"))
+             for h in self.history), default=float("inf"))
+
+        def run_pass(batch_iter, train: bool, epoch: int) -> dict:
+            total, steps = 0.0, 0
+            msums = {name: 0.0 for name in self.metrics}
+            for xb, yb in batch_iter:
+                if train:
+                    opt.zero_grad()
+                    out = self.model(xb)
+                    loss = self.loss(out, yb)
+                    loss.backward()
+                    opt.step()
+                else:
+                    with torch.no_grad():
+                        out = self.model(xb)
+                        loss = self.loss(out, yb)
+                total += float(loss.detach())
+                for name, fn in self.metrics.items():
+                    with torch.no_grad():
+                        msums[name] += float(fn(out.detach(), yb))
+                steps += 1
+            stage = "train" if train else "val"
+            result = {"loss": self._avg_scalar(
+                total, steps, f"{stage}.loss.{epoch}", distributed,
+                hvd_torch)}
+            for name in self.metrics:
+                result[name] = self._avg_scalar(
+                    msums[name], steps, f"{stage}.{name}.{epoch}",
+                    distributed, hvd_torch)
+            return result
+
+        for epoch in range(start_epoch, self.epochs):
+            self.model.train()
+            entry = {"epoch": epoch,
+                     "train": run_pass(train_batches(epoch), True, epoch)}
+            vb = val_batches() if val_batches is not None else None
+            if vb is not None:
+                self.model.eval()
+                entry["validation"] = run_pass(vb, False, epoch)
+                self.model.train()
+            self.history.append(entry)
+            if self.verbose and rank0:
+                log.info("TorchEstimator %s", entry)
+            if self.store is not None and rank0:
+                # per-epoch checkpoint + best-model tracking (reference
+                # saves every epoch; best is kept separately so a
+                # regression in late epochs cannot lose the best weights)
+                self.save_checkpoint(optimizer=ckpt_opt, epoch=epoch)
+                score = entry.get("validation", entry["train"])["loss"]
+                if score <= best_val:
+                    best_val = score
+                    self.save_checkpoint(optimizer=ckpt_opt, epoch=epoch,
+                                         path=self.best_checkpoint_path())
+        return self.history
 
     def fit(self, df) -> TorchModel:
         """Train on a pandas (hermetic) or pyspark DataFrame. Under a
@@ -153,13 +299,12 @@ class TorchEstimator:
         except Exception:
             distributed = False
         if distributed:
+            # (no broadcast here: _epoch_loop broadcasts after its resume
+            # check, which must win over initial weights)
             opt = hvd_torch.DistributedOptimizer(
                 opt, named_parameters=self.model.named_parameters(),
                 backward_passes_per_step=self.backward_passes_per_step)
-            hvd_torch.broadcast_parameters(self.model.state_dict(),
-                                           root_rank=0)
 
-        loss_fn = self.loss
         xt = torch.from_numpy(np.ascontiguousarray(x))
         yt = torch.from_numpy(np.ascontiguousarray(y))
         if distributed:
@@ -167,27 +312,27 @@ class TorchEstimator:
             # row-group sharding per rank)
             r, n = hvd_torch.cross_rank(), hvd_torch.cross_size()
             xt, yt = xt[r::n], yt[r::n]
-        self.model.train()
-        for epoch in range(self.epochs):
-            perm = torch.randperm(len(xt))
-            total = 0.0
+
+        def train_batches(epoch):
+            gen = torch.Generator().manual_seed(epoch)
+            perm = torch.randperm(len(xt), generator=gen)
             for i in range(0, len(xt), self.batch_size):
                 idx = perm[i:i + self.batch_size]
-                opt.zero_grad()
-                out = self.model(xt[idx])
-                loss = loss_fn(out, yt[idx])
-                loss.backward()
-                opt.step()
-                total += float(loss.detach())
-            if self.verbose:
-                import logging
+                yield xt[idx], yt[idx]
 
-                logging.getLogger("horovod_tpu").info(
-                    "TorchEstimator epoch %d loss %.5f", epoch, total)
-        self._log_validation(x_val, y_val)
-        # (no checkpoint here: store-backed fits return via _fit_from_store,
-        # which owns checkpointing; the in-memory path has no store)
-        return TorchModel(self.model, self.feature_cols)
+        val_batches = None
+        if x_val is not None:
+            xv = torch.from_numpy(np.ascontiguousarray(x_val))
+            yv = torch.from_numpy(np.ascontiguousarray(y_val))
+
+            def val_batches():
+                for i in range(0, len(xv), self.batch_size):
+                    yield xv[i:i + self.batch_size], yv[i:i + self.batch_size]
+
+        self._epoch_loop(opt, train_batches, val_batches, distributed,
+                         hvd_torch)
+        return TorchModel(self.model, self.feature_cols,
+                          history=self.history)
 
     # -- store-backed streaming path (reference util.py:747 + petastorm) ----
     def _fit_from_store(self, df) -> TorchModel:
@@ -239,15 +384,16 @@ class TorchEstimator:
         train_chunks = list(range(n_chunks - n_val))
         ds = StoreDataset(self.store, train_path, shard_id=r, num_shards=n,
                           chunks=train_chunks)
-        val_ds = (StoreDataset(self.store, train_path, shard_id=0,
-                               num_shards=1,
+        # validation shards across ranks too: the epoch metric is the
+        # allreduce-average of shard means, so each rank reading 1/n of
+        # the val chunks gives the same number at 1/n the IO
+        val_ds = (StoreDataset(self.store, train_path, shard_id=r,
+                               num_shards=n,
                                chunks=list(range(n_chunks - n_val, n_chunks)))
                   if n_val else None)
         return self._train_streaming(ds, val_ds, distributed)
 
     def _train_streaming(self, ds, val_ds, distributed: bool) -> TorchModel:
-        import logging
-
         import numpy as np
         import torch
 
@@ -255,11 +401,11 @@ class TorchEstimator:
 
         opt = self._make_optimizer()
         if distributed:
+            # (no broadcast here: _epoch_loop broadcasts after its resume
+            # check, which must win over initial weights)
             opt = hvd_torch.DistributedOptimizer(
                 opt, named_parameters=self.model.named_parameters(),
                 backward_passes_per_step=self.backward_passes_per_step)
-            hvd_torch.broadcast_parameters(self.model.state_dict(),
-                                           root_rank=0)
         # symmetric step count: every rank must run the same number of
         # optimizer steps per epoch (each step allreduces); computed from
         # staged metadata alone, no negotiation round. Tail batches beyond
@@ -273,40 +419,25 @@ class TorchEstimator:
                 "staging_chunk_rows or fewer workers)")
         self.last_train_dataset = ds  # observability (tests assert the
         #                               streaming property on it)
-        loss_fn = self.loss
-        self.model.train()
-        for epoch in range(self.epochs):
-            total, steps = 0.0, 0
+
+        def tt(a):
+            return torch.from_numpy(np.ascontiguousarray(a))
+
+        def train_batches(epoch):
             for xb, yb in ds.batches(self.batch_size, shuffle_seed=epoch,
                                      limit=limit):
-                xt = torch.from_numpy(np.ascontiguousarray(xb))
-                yt = torch.from_numpy(np.ascontiguousarray(yb))
-                opt.zero_grad()
-                loss = loss_fn(self.model(xt), yt)
-                loss.backward()
-                opt.step()
-                total += float(loss.detach())
-                steps += 1
-            if self.verbose:
-                logging.getLogger("horovod_tpu").info(
-                    "TorchEstimator[store] epoch %d loss %.5f (%d steps)",
-                    epoch, total / max(steps, 1), steps)
-        if val_ds is not None and self.verbose:
-            self.model.eval()
-            vtotal, vn = 0.0, 0
-            with torch.no_grad():
+                yield tt(xb), tt(yb)
+
+        val_batches = None
+        if val_ds is not None:
+            def val_batches():
                 for xb, yb in val_ds.batches(self.batch_size):
-                    vtotal += float(loss_fn(
-                        self.model(torch.from_numpy(np.ascontiguousarray(xb))),
-                        torch.from_numpy(np.ascontiguousarray(yb))))
-                    vn += 1
-            logging.getLogger("horovod_tpu").info(
-                "TorchEstimator[store] validation loss %.5f",
-                vtotal / max(vn, 1))
-            self.model.train()
-        if not distributed or hvd_torch.cross_rank() == 0:
-            self.save_checkpoint()
-        return TorchModel(self.model, self.feature_cols)
+                    yield tt(xb), tt(yb)
+
+        self._epoch_loop(opt, train_batches, val_batches, distributed,
+                         hvd_torch)
+        return TorchModel(self.model, self.feature_cols,
+                          history=self.history)
 
     def _fit_multiproc_store(self) -> TorchModel:
         """num_proc workers stream their own store shards — no dataset
@@ -325,8 +456,9 @@ class TorchEstimator:
 
             est.fit(None)  # store path: reuses the staged chunks
             if hvd_torch.cross_rank() == 0:
-                return {k: v.cpu()
-                        for k, v in est.model.state_dict().items()}
+                return ({k: v.cpu()
+                         for k, v in est.model.state_dict().items()},
+                        est.history)
             return None
 
         settings = ElasticFunctionExecutor.create_settings(
@@ -339,9 +471,10 @@ class TorchEstimator:
             results = ex.run(worker, args=(self,))
         finally:
             ex.shutdown()
-        state = next(r for r in results if r is not None)
+        state, self.history = next(r for r in results if r is not None)
         self.model.load_state_dict(state)
-        return TorchModel(self.model, self.feature_cols)
+        return TorchModel(self.model, self.feature_cols,
+                          history=self.history)
 
     def _log_validation(self, x_val, y_val):
         if x_val is None or not self.verbose:
@@ -373,6 +506,7 @@ class TorchEstimator:
         est = TorchEstimator(
             model=self.model, optimizer=self.optimizer, loss=self.loss,
             feature_cols=["__f"], label_cols=["__y"],
+            metrics=self.metrics,  # x/y arrive pre-split: no re-split here
             batch_size=self.batch_size, epochs=self.epochs,
             backward_passes_per_step=self.backward_passes_per_step,
             verbose=self.verbose)
@@ -386,7 +520,9 @@ class TorchEstimator:
             df = pd.DataFrame({"__f": list(x), "__y": list(y)})
             est.fit(df)
             if hvd_torch.cross_rank() == 0:
-                return {k: v.cpu() for k, v in est.model.state_dict().items()}
+                return ({k: v.cpu()
+                         for k, v in est.model.state_dict().items()},
+                        est.history)
             return None
 
         settings = ElasticFunctionExecutor.create_settings(
@@ -399,9 +535,10 @@ class TorchEstimator:
             results = ex.run(worker, args=(est, x, y))
         finally:
             ex.shutdown()
-        state = next(r for r in results if r is not None)
+        state, self.history = next(r for r in results if r is not None)
         self.model.load_state_dict(state)
         self._log_validation(x_val, y_val)
         if self.store is not None:
             self.save_checkpoint()
-        return TorchModel(self.model, self.feature_cols)
+        return TorchModel(self.model, self.feature_cols,
+                          history=self.history)

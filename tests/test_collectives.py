@@ -249,3 +249,72 @@ def test_eager_allreduce_numpy_input_still_works():
     hvd.init()
     out = hvd.allreduce(np.full((8,), 3.0, np.float32), average=True)
     np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+# --- hierarchical adasum (reference adasum_gpu_operations.cc) ---------------
+
+def _hier_mesh(nc, nl):
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    devs = _np.array(jax.devices()[:nc * nl]).reshape(nc, nl)
+    return Mesh(devs, ("cross", "local"))
+
+
+def _hier_adasum(x, nc=4, nl=2):
+    mesh = _hier_mesh(nc, nl)
+    f = jax.shard_map(
+        lambda t: hvd.adasum_allreduce_hierarchical(t[0, 0], "local",
+                                                    "cross"),
+        mesh=mesh, in_specs=P("cross", "local"), out_specs=P(),
+        check_vma=False)
+    return np.asarray(f(x))
+
+
+def _flat_adasum_rows(rows):
+    """Reference combine on the host: pairwise tree over the rows."""
+    from horovod_tpu.ops.adasum import adasum_tree_reduce
+
+    return np.asarray(adasum_tree_reduce(jnp.asarray(rows)))
+
+
+def test_hier_adasum_equals_flat_adasum_of_local_means():
+    # local mean -> cross adasum -> local broadcast: with the chunked
+    # hypercube's dot/norm scalars psummed over the local axis, the
+    # result must EQUAL unchunked Adasum of the per-group means
+    nc, nl, d = 4, 2, 13  # 13: exercises the chunk padding
+    rng = np.random.RandomState(7)
+    x = rng.randn(nc, nl, d).astype(np.float32)
+    out = _hier_adasum(x, nc, nl)
+    expect = _flat_adasum_rows(x.mean(axis=1))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_hier_adasum_identical_within_group_matches_flat():
+    # when every local chip holds its group's same gradient, hierarchy
+    # degenerates to flat Adasum over the groups
+    nc, nl, d = 2, 4, 8
+    rng = np.random.RandomState(8)
+    g = rng.randn(nc, d).astype(np.float32)
+    x = np.repeat(g[:, None, :], nl, axis=1)
+    out = _hier_adasum(x, nc, nl)
+    expect = _flat_adasum_rows(g)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_hier_adasum_scale_invariance():
+    # the same scale-robustness property the flat op guarantees
+    nc, nl, d = 4, 2, 8
+    rng = np.random.RandomState(9)
+    x = rng.randn(nc, nl, d).astype(np.float32)
+    o1 = _hier_adasum(x, nc, nl)
+    o2 = _hier_adasum(100.0 * x, nc, nl)
+    np.testing.assert_allclose(o2, 100.0 * o1, rtol=1e-4)
+
+
+def test_hier_adasum_identical_gradients_average():
+    # adasum(identical everything) = the gradient itself
+    v = np.random.RandomState(10).randn(8).astype(np.float32)
+    x = np.tile(v, (4, 2, 1))
+    out = _hier_adasum(x, 4, 2)
+    np.testing.assert_allclose(out, v, rtol=1e-4, atol=1e-5)

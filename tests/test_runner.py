@@ -279,3 +279,89 @@ def test_source_address_for_loopback_real():
     from horovod_tpu.runner.network import source_address_for
 
     assert source_address_for("127.0.0.1") == "127.0.0.1"
+
+
+# --- scheduler-allocation ingestion (reference js_run.py / util/lsf.py) ----
+
+def test_slurm_nodelist_expansion():
+    from horovod_tpu.runner.hosts import _expand_slurm_nodelist as ex
+
+    assert ex("node[001-003,007]") == ["node001", "node002", "node003",
+                                       "node007"]
+    assert ex("n[1-2]x,login1") == ["n1x", "n2x", "login1"]
+    assert ex("single") == ["single"]
+    assert ex("a[1,3],b[02-03]") == ["a1", "a3", "b02", "b03"]
+    # multiple bracket groups per name (valid SLURM compression)
+    assert ex("rack[1-2]n[1-2]") == ["rack1n1", "rack1n2",
+                                     "rack2n1", "rack2n2"]
+
+
+def test_slurm_tasks_per_node_expansion():
+    from horovod_tpu.runner.hosts import _expand_slurm_tasks_per_node as ex
+
+    assert ex("2(x3),1", 4) == [2, 2, 2, 1]
+    assert ex("4", 1) == [4]
+    assert ex("2(x2)", 3) == [2, 2, 2]  # padded with the last count
+
+
+def test_hosts_from_allocation_lsf_hostfile(tmp_path):
+    from horovod_tpu.runner.hosts import hosts_from_allocation
+
+    hf = tmp_path / "djob"
+    hf.write_text("batch1\nbatch1\nbatch1\nbatch2\n")
+    hosts = hosts_from_allocation({"LSB_DJOB_HOSTFILE": str(hf)})
+    assert [(h.hostname, h.slots) for h in hosts] == [("batch1", 3),
+                                                      ("batch2", 1)]
+
+
+def test_hosts_from_allocation_lsf_mcpu_and_slurm():
+    from horovod_tpu.runner.hosts import hosts_from_allocation
+
+    hosts = hosts_from_allocation({"LSB_MCPU_HOSTS": "h1 4 h2 2"})
+    assert [(h.hostname, h.slots) for h in hosts] == [("h1", 4), ("h2", 2)]
+
+    hosts = hosts_from_allocation({
+        "SLURM_JOB_NODELIST": "gpu[01-02]",
+        "SLURM_TASKS_PER_NODE": "2(x2)",
+    })
+    assert [(h.hostname, h.slots) for h in hosts] == [("gpu01", 2),
+                                                      ("gpu02", 2)]
+
+    with pytest.raises(ValueError):
+        hosts_from_allocation({})
+
+
+def test_from_allocation_slot_assignments(tmp_path):
+    """--from-allocation end to end: a faked SLURM allocation produces
+    correct rank/local/cross assignments (reference js_run.py intent)."""
+    from horovod_tpu.runner.hosts import (get_host_assignments,
+                                          hosts_from_allocation)
+
+    env = {"SLURM_JOB_NODELIST": "tpu[1-3]",
+           "SLURM_TASKS_PER_NODE": "2(x3)"}
+    hosts = hosts_from_allocation(env)
+    slots = get_host_assignments(hosts, 6)
+    assert len(slots) == 6
+    assert [s.hostname for s in slots] == ["tpu1", "tpu1", "tpu2", "tpu2",
+                                           "tpu3", "tpu3"]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1, 0, 1]
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1, 2, 2]
+    assert all(s.size == 6 and s.local_size == 2 and s.cross_size == 3
+               for s in slots)
+
+
+def test_from_allocation_cli_local(tmp_path, monkeypatch):
+    """hvdrun --from-allocation with a single-local-host allocation
+    actually launches (exec path, np defaulted from the allocation)."""
+    from horovod_tpu.runner.launch import run_commandline
+
+    hf = tmp_path / "djob"
+    hf.write_text("localhost\nlocalhost\n")
+    monkeypatch.setenv("LSB_DJOB_HOSTFILE", str(hf))
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['HOROVOD_SIZE'] == '2'\n"
+        "print('alloc rank', os.environ['HOROVOD_RANK'])\n")
+    rc = run_commandline(["--from-allocation", sys.executable, str(script)])
+    assert rc == 0
