@@ -7,7 +7,9 @@ synthetic ImageNet-shaped data, full training step (forward + backward +
 gradient allreduce + update), report images/sec — plus:
 
 - ``mfu``: model FLOPs utilization against the detected chip's bf16 peak
-  (ResNet-50 fwd ≈ 4.09 GFLOP/img at 224², training ≈ 3× fwd).
+  (ResNet-50 fwd = 2 × 4.09 GMACs = 8.18 GFLOP/img at 224², training ≈
+  3× fwd — the standard 2-FLOPs-per-MAC convention, audited against
+  XLA cost_analysis in benchmarks/conv_analysis_cpu.py).
 - ``allreduce_gbps``: eager fused allreduce bandwidth (BASELINE's stated
   collective metric; config 3 adds bf16-compressed wire format).
 - ``adasum_step_ms``: Adasum reduction step (config 4).
@@ -41,8 +43,14 @@ from horovod_tpu.parallel import data_parallel_step
 
 BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
 
-RESNET50_FWD_FLOP_PER_IMG = 4.09e9
-RESNET101_FWD_FLOP_PER_IMG = 7.8e9  # MAC-counted, same convention
+# FLOPs (2 x MACs — the standard MFU convention, and what XLA's own
+# cost_analysis counts). ResNet-50 fwd = 4.09 GMACs = 8.18 GFLOP/img at
+# 224^2; ResNet-101 = 7.8 GMACs. Rounds 1-4 mistakenly used the MAC
+# count as the FLOP count, UNDERSTATING MFU by ~2x (audited against
+# jax cost_analysis: analytic/xla = 0.47 before the fix, ~0.95 after —
+# benchmarks/conv_analysis_cpu.py, docs/benchmarks.md round-5 section).
+RESNET50_FWD_FLOP_PER_IMG = 2 * 4.09e9
+RESNET101_FWD_FLOP_PER_IMG = 2 * 7.8e9
 TRAIN_FLOP_MULT = 3.0  # fwd + bwd ≈ 3x fwd
 
 # HVD_BENCH_MODEL picks the benchmarked model. resnet101 exists so the

@@ -9,7 +9,7 @@ import optax
 
 from horovod_tpu.models import ResNet50
 
-FWD = 4.09e9
+FWD = 2 * 4.09e9  # FLOPs (2 x MACs), matching bench.py round-5 correction
 PEAK = 197e12
 
 

@@ -64,7 +64,7 @@ def main():
     batch = 256
     images = jnp.asarray(np.random.RandomState(0).randn(batch, 224, 224, 3),
                          jnp.bfloat16)
-    FWD = 4.09e9
+    FWD = 2 * 4.09e9  # FLOPs (2 x MACs), bench.py round-5 convention
 
     import horovod_tpu.models.resnet as resnet_mod
 

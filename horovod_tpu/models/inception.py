@@ -162,6 +162,7 @@ class InceptionV3(nn.Module):
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 
 
-# fwd compute per image at 299x299, MAC-counted (same convention as
-# bench.py's ResNet-50 4.09e9 and vgg.py — cross-model numbers compare)
-INCEPTION3_FWD_FLOP_PER_IMG = 5.7e9
+# fwd FLOPs per image at 299x299 = 2 x 5.7e9 MACs (the 2-FLOPs-per-MAC
+# convention of bench.py's round-5 correction and vgg.py — cross-model
+# numbers compare)
+INCEPTION3_FWD_FLOP_PER_IMG = 2 * 5.7e9

@@ -53,7 +53,6 @@ def VGG19(**kw) -> VGG:
     return VGG(stages=_VGG19_STAGES, **kw)
 
 
-# fwd compute per image at 224x224, MAC-counted (the convention of the
-# commonly-quoted model costs and of bench.py's ResNet-50 4.09e9):
-# convs ~15.3e9 MACs + classifier ~0.12e9
-VGG16_FWD_FLOP_PER_IMG = 15.5e9
+# fwd FLOPs per image at 224x224 = 2 x 15.5e9 MACs (2-FLOPs-per-MAC,
+# bench.py round-5 convention): convs ~15.3e9 MACs + classifier ~0.12e9
+VGG16_FWD_FLOP_PER_IMG = 2 * 15.5e9
