@@ -331,6 +331,10 @@ def main():
         "per_chip_batch": per_chip,
         "scan_steps": scan_steps,
         "device": jax.devices()[0].device_kind,
+        # r5: constants corrected to 2 FLOPs/MAC (rounds 1-4 understated
+        # mfu ~2x; round-1's 2241 img/s was ~0.28 mfu in this convention)
+        "flop_convention": "2xMAC (audited vs XLA cost_analysis, "
+                           "benchmarks/conv_analysis_cpu.py)",
     }
     # mfu is the headline quality number. vs_baseline (kept for the driver
     # contract) divides by the only absolute throughput the reference

@@ -53,6 +53,12 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
     if getattr(cls, "_hvd_wrapped", False):
         raise ValueError("optimizer is already a DistributedOptimizer")
     bpps = int(backward_passes_per_step)
+    # Keras 3's BaseOptimizer funnels apply_gradients → apply; Keras 2
+    # (tf_keras, the reference's generation — active under
+    # TF_USE_LEGACY_KERAS=1) has no ``apply`` and must be intercepted at
+    # apply_gradients instead. Overriding the wrong one is a SILENT
+    # no-op: training runs, gradients never average.
+    k3_funnel = hasattr(cls, "apply")
 
     class _Distributed(cls):
         _hvd_wrapped = True
@@ -116,9 +122,41 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
             return [keras.ops.convert_to_tensor(r.astype(a.dtype))
                     for r, a in zip(reduced, arrs)]
 
-        # NOTE: apply_gradients is intentionally NOT overridden. Keras 3's
-        # BaseOptimizer.apply_gradients delegates to self.apply, so apply()
-        # is the single funnel — reducing in both would allreduce twice.
+        # NOTE (Keras 3): apply_gradients is intentionally NOT overridden
+        # there — BaseOptimizer.apply_gradients delegates to self.apply,
+        # so apply() is the single funnel and reducing in both would
+        # allreduce twice. On Keras 2 the conditional apply_gradients
+        # override below IS the funnel (and cls.apply doesn't exist).
+
+        if not k3_funnel:
+            def apply_gradients(self, grads_and_vars, **kwargs):
+                gv = [(g, v) for g, v in grads_and_vars]
+                # filter None grads BEFORE the wire (tf_keras's own
+                # filter_empty_gradients runs inside the base apply, too
+                # late for the reduce): a variable unconnected to the
+                # loss passes through untouched, matching the reference
+                live = [i for i, (g, _) in enumerate(gv) if g is not None]
+                grads = self._hvd_densify([gv[i][0] for i in live])
+                varis = [gv[i][1] for i in live]
+                if bpps <= 1:
+                    red = self._hvd_reduce(grads)
+                    out = list(gv)
+                    for i, g in zip(live, red):
+                        out[i] = (g, gv[i][1])
+                    return super().apply_gradients(out, **kwargs)
+                # slots must exist OUTSIDE the commit cond (graph-traced
+                # train steps reject variable creation inside control
+                # flow); tf_keras's new optimizer builds from a var list,
+                # older optimizer_v2 has no build() and creates slots
+                # eagerly on first apply
+                try:
+                    self.build(list(varis))
+                except (AttributeError, TypeError):
+                    pass
+                base_apply = super(_Distributed, self).apply_gradients
+                return self._hvd_aggregate_then(
+                    grads,
+                    lambda gs: base_apply(list(zip(gs, varis)), **kwargs))
 
         def apply(self, grads, trainable_variables=None, **kwargs):
             grads = self._hvd_densify(list(grads))
@@ -135,22 +173,29 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                     "backward_passes_per_step > 1 requires the tensorflow "
                     "keras backend (for JAX training loops use "
                     "horovod_tpu.opt with gradient accumulation instead)")
-            return self._hvd_apply_aggregated(grads, trainable_variables,
-                                              **kwargs)
-
-        def _hvd_apply_aggregated(self, grads, trainable_variables,
-                                  **kwargs):
-            """Local gradient aggregation (reference
-            horovod/tensorflow/gradient_aggregation.py): accumulate
-            ``backward_passes_per_step`` local gradients, then allreduce
-            the aggregate and run the real update once. tf.Variable
-            counter + tf.cond keep the commit live inside a traced
-            train_step; on skipped steps the base optimizer does not run
-            at all (no slot/iteration pollution from zero grads)."""
-            import tensorflow as tf
-
             if trainable_variables is not None:
                 self.build(list(trainable_variables))  # slots outside cond
+            base_apply = super(_Distributed, self).apply
+
+            def commit_apply(gs):
+                if trainable_variables is None:
+                    base_apply(gs, **kwargs)
+                else:
+                    base_apply(gs, list(trainable_variables), **kwargs)
+
+            return self._hvd_aggregate_then(grads, commit_apply)
+
+        def _hvd_aggregate_then(self, grads, commit_apply):
+            """Local gradient aggregation (reference
+            horovod/tensorflow/gradient_aggregation.py), shared by both
+            optimizer generations: accumulate ``backward_passes_per_step``
+            local gradients, then allreduce the aggregate and run the
+            real update once via ``commit_apply``. tf.Variable counter +
+            tf.cond keep the commit live inside a traced train_step; on
+            skipped steps the base optimizer does not run at all (no
+            slot/iteration pollution from zero grads)."""
+            import tensorflow as tf
+
             if getattr(self, "_hvd_agg", None) is None:
                 self._hvd_agg = [
                     tf.Variable(tf.zeros(g.shape, g.dtype), trainable=False)
@@ -161,17 +206,13 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
             for a, g in zip(self._hvd_agg, grads):
                 a.assign_add(tf.cast(g, a.dtype))
             self._hvd_counter.assign_add(1)
-            base_apply = super(_Distributed, self).apply
 
             def commit():
                 gs = [a.read_value() for a in self._hvd_agg]
                 if average_aggregated_gradients:
                     gs = [g / float(bpps) for g in gs]
                 gs = self._hvd_reduce(gs)
-                if trainable_variables is None:
-                    base_apply(gs, **kwargs)
-                else:
-                    base_apply(gs, list(trainable_variables), **kwargs)
+                commit_apply(gs)
                 for a in self._hvd_agg:
                     a.assign(tf.zeros(a.shape, a.dtype))
                 return tf.constant(True)

@@ -1,222 +1,293 @@
 """Keras callbacks (reference horovod/_keras/callbacks.py +
 keras/callbacks.py): broadcast-on-start, metric averaging, LR warmup and
 schedules, elastic state commits — attached to a real ``model.fit`` loop.
+
+Parameterized over the Keras backend module (the reference passes ``k``
+through every Impl class for the same reason): the classes must subclass
+THAT generation's ``Callback`` — a Keras-3 subclass handed to a tf_keras
+(Keras 2, TF_USE_LEGACY_KERAS=1) ``model.fit`` fails its callback-list
+introspection. ``for_backend(k)`` returns a namespace of classes built
+against ``k``; the module-level names are the Keras-3 instances for the
+standalone `horovod_tpu.keras` surface.
 """
 
 from __future__ import annotations
 
-import keras
 import numpy as np
 
 import horovod_tpu as _core
 
 
-class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
-    """Broadcast all model/optimizer variables from ``root_rank`` at the
-    start of training (reference BroadcastGlobalVariablesCallbackImpl):
-    every worker starts from identical state after random init or a
-    rank-0-only checkpoint restore."""
+def build_callback_classes(keras):
+    """Build the callback classes against ``keras`` (keras 3 or tf_keras)."""
+    class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+        """Broadcast all model/optimizer variables from ``root_rank`` at the
+        start of training (reference BroadcastGlobalVariablesCallbackImpl):
+        every worker starts from identical state after random init or a
+        rank-0-only checkpoint restore."""
 
-    def __init__(self, root_rank: int = 0):
-        super().__init__()
-        self.root_rank = root_rank
-        self._done = False
+        def __init__(self, root_rank: int = 0):
+            super().__init__()
+            self.root_rank = root_rank
+            self._done = False
 
-    def on_train_begin(self, logs=None):
-        if self._done:
-            return
-        self._done = True
-        if _core.cross_size() <= 1:
-            return
-        variables = list(self.model.variables)
-        opt = getattr(self.model, "optimizer", None)
-        if opt is not None:
-            variables += list(getattr(opt, "variables", []) or [])
-        for i, v in enumerate(variables):
-            out = _core.synchronize(_core.broadcast_async(
-                np.asarray(v), self.root_rank, f"keras.bcast.{i}"))
-            v.assign(np.asarray(out).astype(np.asarray(v).dtype))
+        def on_train_batch_end(self, batch, logs=None):
+            # The reference broadcasts at the end of batch 0
+            # (BroadcastGlobalVariablesCallbackImpl) and so do we — NOT
+            # at on_train_begin: Keras 2 builds the model lazily (no
+            # weights exist yet there), and even on a pre-built model
+            # the optimizer's slot variables (momentum/Adam moments)
+            # only materialize at the first apply_gradients — an early
+            # broadcast would sync weights but let restored optimizer
+            # state silently diverge.
+            self._maybe_broadcast()
 
-
-class MetricAverageCallback(keras.callbacks.Callback):
-    """Average epoch metrics over all workers before they reach other
-    callbacks (reference MetricAverageCallbackImpl) — so checkpointing /
-    early stopping see global, not rank-local, values."""
-
-    def on_epoch_end(self, epoch, logs=None):
-        if not logs or _core.cross_size() <= 1:
-            return
-        keys = sorted(k for k, v in logs.items()
-                      if isinstance(v, (int, float, np.floating)))
-        if not keys:
-            return
-        vals = np.asarray([float(logs[k]) for k in keys], np.float32)
-        avg = np.asarray(_core.synchronize(_core.allreduce_async(
-            vals, average=True, name=f"keras.metrics.e{epoch}")))
-        for k, v in zip(keys, avg):
-            logs[k] = float(v)
-
-
-class LearningRateWarmupCallback(keras.callbacks.Callback):
-    """Linear LR ramp from ``initial_lr / size`` (or given start) to
-    ``initial_lr`` over the first ``warmup_epochs`` (reference
-    LearningRateWarmupCallbackImpl — the Goyal et al. large-batch recipe).
-    """
-
-    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
-                 momentum_correction: bool = True, steps_per_epoch=None,
-                 verbose: int = 0):
-        super().__init__()
-        self.initial_lr = initial_lr
-        self.warmup_epochs = warmup_epochs
-        self.steps_per_epoch = steps_per_epoch
-        self.verbose = verbose
-        self._current_epoch = 0
-
-    def _set_lr(self, lr: float):
-        self.model.optimizer.learning_rate.assign(lr)
-
-    def on_epoch_begin(self, epoch, logs=None):
-        self._current_epoch = epoch
-
-    def on_train_batch_begin(self, batch, logs=None):
-        if self._current_epoch >= self.warmup_epochs:
-            return
-        spe = self.steps_per_epoch or self.params.get("steps") or 1
-        progress = (self._current_epoch * spe + batch + 1) / float(
-            self.warmup_epochs * spe)
-        base = self.initial_lr / max(_core.size(), 1)
-        self._set_lr(base + (self.initial_lr - base) * min(progress, 1.0))
-
-    def on_epoch_end(self, epoch, logs=None):
-        if epoch == self.warmup_epochs - 1 and self.verbose:
-            print(f"warmup complete: lr={self.initial_lr}")
+        def _maybe_broadcast(self):
+            if self._done:
+                return
+            if _core.cross_size() <= 1:
+                self._done = True
+                return
+            try:
+                variables = list(self.model.variables)
+            except ValueError:
+                return  # model not built yet: wait for the first batch
+            if not variables:
+                return
+            self._done = True
+            opt = getattr(self.model, "optimizer", None)
+            if opt is not None:
+                ovars = getattr(opt, "variables", None)
+                if callable(ovars):  # Keras 2: variables() is a method
+                    ovars = ovars()
+                variables += list(ovars or [])
+            for i, v in enumerate(variables):
+                out = _core.synchronize(_core.broadcast_async(
+                    np.asarray(v), self.root_rank, f"keras.bcast.{i}"))
+                v.assign(np.asarray(out).astype(np.asarray(v).dtype))
 
 
-class LearningRateScheduleCallback(keras.callbacks.Callback):
-    """Multiply the LR by ``multiplier`` inside [start_epoch, end_epoch)
-    (reference LearningRateScheduleCallbackImpl)."""
+    class MetricAverageCallback(keras.callbacks.Callback):
+        """Average epoch metrics over all workers before they reach other
+        callbacks (reference MetricAverageCallbackImpl) — so checkpointing /
+        early stopping see global, not rank-local, values."""
 
-    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
-                 end_epoch=None, staircase: bool = True):
-        super().__init__()
-        self.initial_lr = initial_lr
-        self.start_epoch = start_epoch
-        self.end_epoch = end_epoch
-        self.staircase = staircase
-        self.multiplier = (multiplier if callable(multiplier)
-                           else (lambda e: multiplier))
-
-    def on_epoch_begin(self, epoch, logs=None):
-        if epoch < self.start_epoch or (
-                self.end_epoch is not None and epoch >= self.end_epoch):
-            return
-        e = epoch if self.staircase else epoch  # per-epoch granularity
-        self.model.optimizer.learning_rate.assign(
-            self.initial_lr * self.multiplier(e))
+        def on_epoch_end(self, epoch, logs=None):
+            if not logs or _core.cross_size() <= 1:
+                return
+            keys = sorted(k for k, v in logs.items()
+                          if isinstance(v, (int, float, np.floating)))
+            if not keys:
+                return
+            vals = np.asarray([float(logs[k]) for k in keys], np.float32)
+            avg = np.asarray(_core.synchronize(_core.allreduce_async(
+                vals, average=True, name=f"keras.metrics.e{epoch}")))
+            for k, v in zip(keys, avg):
+                logs[k] = float(v)
 
 
-class CommitStateCallback(keras.callbacks.Callback):
-    """Commit elastic state every ``batches_per_commit`` batches from a
-    ``model.fit`` loop, plus at every epoch end (reference keras elastic
-    CommitStateCallbackImpl: the end-of-epoch state — batch reset, epoch
-    advanced — must be durable, and the batch counter resets at train
-    begin so restarted workers commit on the same boundaries)."""
+    class LearningRateWarmupCallback(keras.callbacks.Callback):
+        """Linear LR ramp from ``initial_lr / size`` (or given start) to
+        ``initial_lr`` over the first ``warmup_epochs`` (reference
+        LearningRateWarmupCallbackImpl — the Goyal et al. large-batch recipe).
+        """
 
-    def __init__(self, state, batches_per_commit: int = 1):
-        super().__init__()
-        self.state = state
-        self.batches_per_commit = int(batches_per_commit)
-        self._i = 0
+        def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                     momentum_correction: bool = True, steps_per_epoch=None,
+                     verbose: int = 0):
+            super().__init__()
+            self.initial_lr = initial_lr
+            self.warmup_epochs = warmup_epochs
+            self.steps_per_epoch = steps_per_epoch
+            self.verbose = verbose
+            self._current_epoch = 0
 
-    def on_train_begin(self, logs=None):
-        self._i = 0
+        def _set_lr(self, lr: float):
+            self.model.optimizer.learning_rate.assign(lr)
 
-    def on_batch_end(self, batch, logs=None):
-        self._i += 1
-        if self.batches_per_commit > 0 and \
-                self._i % self.batches_per_commit == 0:
+        def on_epoch_begin(self, epoch, logs=None):
+            self._current_epoch = epoch
+
+        def on_train_batch_begin(self, batch, logs=None):
+            if self._current_epoch >= self.warmup_epochs:
+                return
+            spe = self.steps_per_epoch or self.params.get("steps") or 1
+            progress = (self._current_epoch * spe + batch + 1) / float(
+                self.warmup_epochs * spe)
+            base = self.initial_lr / max(_core.size(), 1)
+            self._set_lr(base + (self.initial_lr - base) * min(progress, 1.0))
+
+        def on_epoch_end(self, epoch, logs=None):
+            if epoch == self.warmup_epochs - 1 and self.verbose:
+                print(f"warmup complete: lr={self.initial_lr}")
+
+
+    class LearningRateScheduleCallback(keras.callbacks.Callback):
+        """Multiply the LR by ``multiplier`` inside [start_epoch, end_epoch)
+        (reference LearningRateScheduleCallbackImpl)."""
+
+        def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                     end_epoch=None, staircase: bool = True):
+            super().__init__()
+            self.initial_lr = initial_lr
+            self.start_epoch = start_epoch
+            self.end_epoch = end_epoch
+            self.staircase = staircase
+            self.multiplier = (multiplier if callable(multiplier)
+                               else (lambda e: multiplier))
+
+        def on_epoch_begin(self, epoch, logs=None):
+            if epoch < self.start_epoch or (
+                    self.end_epoch is not None and epoch >= self.end_epoch):
+                return
+            e = epoch if self.staircase else epoch  # per-epoch granularity
+            self.model.optimizer.learning_rate.assign(
+                self.initial_lr * self.multiplier(e))
+
+
+    class CommitStateCallback(keras.callbacks.Callback):
+        """Commit elastic state every ``batches_per_commit`` batches from a
+        ``model.fit`` loop, plus at every epoch end (reference keras elastic
+        CommitStateCallbackImpl: the end-of-epoch state — batch reset, epoch
+        advanced — must be durable, and the batch counter resets at train
+        begin so restarted workers commit on the same boundaries)."""
+
+        def __init__(self, state, batches_per_commit: int = 1):
+            super().__init__()
+            self.state = state
+            self.batches_per_commit = int(batches_per_commit)
+            self._i = 0
+
+        def on_train_begin(self, logs=None):
+            self._i = 0
+
+        def on_batch_end(self, batch, logs=None):
+            self._i += 1
+            if self.batches_per_commit > 0 and \
+                    self._i % self.batches_per_commit == 0:
+                self.state.commit()
+
+        def on_epoch_end(self, epoch, logs=None):
             self.state.commit()
 
-    def on_epoch_end(self, epoch, logs=None):
-        self.state.commit()
+
+    class UpdateBatchStateCallback(keras.callbacks.Callback):
+        """Track batch/epoch progress in elastic state (reference keras
+        elastic UpdateBatchStateCallback). Keras 3's fit loop cannot skip
+        already-processed batches from a callback (the reference shrank
+        ``params['steps']``, a Keras-2 mechanism), so mid-epoch resume is
+        dataset-side: restart ``model.fit`` with a dataset that skips
+        ``state.batch`` batches and ``steps_per_epoch`` reduced to match
+        (see docs/elastic.md and test_keras_api.py's mid-epoch resume test).
+        This callback supports that contract by offsetting Keras's
+        within-fit batch index with the restored ``state.batch`` on the
+        resumed epoch (the reference's ``state.batch + batch + 1``), so the
+        committed counter stays the TRUE epoch position.
+
+        Order this callback BEFORE CommitStateCallback in the callbacks list
+        (Keras invokes callbacks in order) so commits persist the updated
+        counters rather than the previous batch's."""
+
+        def __init__(self, state):
+            super().__init__()
+            self.state = state
+            self._offset = 0
+            self._resumed_fit = False
+
+        def on_train_begin(self, logs=None):
+            # resuming mid-epoch: Keras restarts batch numbering at 0, but
+            # state.batch batches of this epoch are already done
+            self._offset = int(getattr(self.state, "batch", 0) or 0)
+            self._resumed_fit = True
+
+        def on_batch_end(self, batch, logs=None):
+            self.state.batch = self._offset + batch + 1
+
+        def on_epoch_begin(self, epoch, logs=None):
+            if not self._resumed_fit:
+                self._offset = 0  # later epochs of this fit start at batch 0
+            self._resumed_fit = False
+            self.state.epoch = epoch
+
+        def on_epoch_end(self, epoch, logs=None):
+            # the durable epoch-boundary snapshot is "next epoch, batch 0" —
+            # a worker restored from it must not repeat the completed epoch
+            self._offset = 0
+            self.state.batch = 0
+            self.state.epoch = epoch + 1
 
 
-class UpdateBatchStateCallback(keras.callbacks.Callback):
-    """Track batch/epoch progress in elastic state (reference keras
-    elastic UpdateBatchStateCallback). Keras 3's fit loop cannot skip
-    already-processed batches from a callback (the reference shrank
-    ``params['steps']``, a Keras-2 mechanism), so mid-epoch resume is
-    dataset-side: restart ``model.fit`` with a dataset that skips
-    ``state.batch`` batches and ``steps_per_epoch`` reduced to match
-    (see docs/elastic.md and test_keras_api.py's mid-epoch resume test).
-    This callback supports that contract by offsetting Keras's
-    within-fit batch index with the restored ``state.batch`` on the
-    resumed epoch (the reference's ``state.batch + batch + 1``), so the
-    committed counter stays the TRUE epoch position.
+    class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+        """Save-best-only checkpoint whose filepath the caller (e.g. the Spark
+        Keras estimator) assigns before fit (reference keras/callbacks.py:151
+        — a ModelCheckpoint pinned to save_best_only=True with filepath left
+        unset so a forgotten assignment fails loudly, not silently into the
+        CWD)."""
 
-    Order this callback BEFORE CommitStateCallback in the callbacks list
-    (Keras invokes callbacks in order) so commits persist the updated
-    counters rather than the previous batch's."""
+        def __init__(self, filepath=None, monitor="val_loss", verbose: int = 0,
+                     mode: str = "auto", save_freq="epoch"):
+            # Keras validates the suffix at construction; a placeholder rides
+            # through and is nulled so an unassigned path fails loudly at save
+            super().__init__(filepath=filepath or "unassigned.keras",
+                             monitor=monitor, verbose=verbose,
+                             save_best_only=True, save_weights_only=False,
+                             mode=mode, save_freq=save_freq)
+            if not filepath:
+                self.filepath = None
 
-    def __init__(self, state):
-        super().__init__()
-        self.state = state
-        self._offset = 0
-        self._resumed_fit = False
+        def _require_filepath(self):
+            if not self.filepath:
+                raise ValueError(
+                    "BestModelCheckpoint.filepath was never assigned (the "
+                    "estimator sets it before fit)")
 
-    def on_train_begin(self, logs=None):
-        # resuming mid-epoch: Keras restarts batch numbering at 0, but
-        # state.batch batches of this epoch are already done
-        self._offset = int(getattr(self.state, "batch", 0) or 0)
-        self._resumed_fit = True
+        def on_epoch_end(self, epoch, logs=None):
+            self._require_filepath()
+            return super().on_epoch_end(epoch, logs)
 
-    def on_batch_end(self, batch, logs=None):
-        self.state.batch = self._offset + batch + 1
+        def on_train_batch_end(self, batch, logs=None):
+            # integer save_freq saves on the batch path too
+            self._require_filepath()
+            return super().on_train_batch_end(batch, logs)
 
-    def on_epoch_begin(self, epoch, logs=None):
-        if not self._resumed_fit:
-            self._offset = 0  # later epochs of this fit start at batch 0
-        self._resumed_fit = False
-        self.state.epoch = epoch
-
-    def on_epoch_end(self, epoch, logs=None):
-        # the durable epoch-boundary snapshot is "next epoch, batch 0" —
-        # a worker restored from it must not repeat the completed epoch
-        self._offset = 0
-        self.state.batch = 0
-        self.state.epoch = epoch + 1
+    return {
+        "BroadcastGlobalVariablesCallback": BroadcastGlobalVariablesCallback,
+        "MetricAverageCallback": MetricAverageCallback,
+        "LearningRateWarmupCallback": LearningRateWarmupCallback,
+        "LearningRateScheduleCallback": LearningRateScheduleCallback,
+        "CommitStateCallback": CommitStateCallback,
+        "UpdateBatchStateCallback": UpdateBatchStateCallback,
+        "BestModelCheckpoint": BestModelCheckpoint,
+    }
 
 
-class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
-    """Save-best-only checkpoint whose filepath the caller (e.g. the Spark
-    Keras estimator) assigns before fit (reference keras/callbacks.py:151
-    — a ModelCheckpoint pinned to save_best_only=True with filepath left
-    unset so a forgotten assignment fails loudly, not silently into the
-    CWD)."""
+class _CallbackNamespace:
+    """Module-like holder so ``hvd.callbacks.X`` reads naturally."""
 
-    def __init__(self, filepath=None, monitor="val_loss", verbose: int = 0,
-                 mode: str = "auto", save_freq="epoch"):
-        # Keras validates the suffix at construction; a placeholder rides
-        # through and is nulled so an unassigned path fails loudly at save
-        super().__init__(filepath=filepath or "unassigned.keras",
-                         monitor=monitor, verbose=verbose,
-                         save_best_only=True, save_weights_only=False,
-                         mode=mode, save_freq=save_freq)
-        if not filepath:
-            self.filepath = None
+    def __init__(self, classes):
+        self.__dict__.update(classes)
 
-    def _require_filepath(self):
-        if not self.filepath:
-            raise ValueError(
-                "BestModelCheckpoint.filepath was never assigned (the "
-                "estimator sets it before fit)")
 
-    def on_epoch_end(self, epoch, logs=None):
-        self._require_filepath()
-        return super().on_epoch_end(epoch, logs)
+_NAMESPACES: dict = {}
 
-    def on_train_batch_end(self, batch, logs=None):
-        # integer save_freq saves on the batch path too
-        self._require_filepath()
-        return super().on_train_batch_end(batch, logs)
+
+def for_backend(keras_module) -> _CallbackNamespace:
+    """Callbacks subclassing ``keras_module``'s Callback (cached)."""
+    key = getattr(keras_module, "__name__", str(id(keras_module)))
+    ns = _NAMESPACES.get(key)
+    if ns is None:
+        ns = _CallbackNamespace(build_callback_classes(keras_module))
+        _NAMESPACES[key] = ns
+    return ns
+
+
+import keras as _keras3  # noqa: E402
+
+_module_level = build_callback_classes(_keras3)
+for _n, _cls in _module_level.items():
+    # picklable module-level classes (spawn-based multiprocessing ships
+    # callback instances by reference): without this the qualname is
+    # build_callback_classes.<locals>.X and pickle cannot resolve it
+    _cls.__module__ = __name__
+    _cls.__qualname__ = _n
+globals().update(_module_level)

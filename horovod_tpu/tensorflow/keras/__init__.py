@@ -11,7 +11,6 @@ from horovod_tpu.keras import (  # noqa: F401
     allgather_object,
     broadcast_object,
     broadcast_variables,
-    callbacks,
     elastic,
     cross_rank,
     cross_size,
@@ -28,3 +27,26 @@ from horovod_tpu.tensorflow.elastic import (  # noqa: F401
     TensorFlowKerasState,
     TensorFlowState,
 )
+
+# callbacks must subclass the generation tf.keras actually resolves to:
+# Keras 3 normally, tf_keras under TF_USE_LEGACY_KERAS=1 (the reference
+# era's API — a Keras-3 Callback handed to tf_keras's fit fails its
+# callback-list introspection)
+import tensorflow as _tf  # noqa: E402
+
+from horovod_tpu._keras.callbacks import for_backend as _cb_for_backend  # noqa: E402
+
+callbacks = _cb_for_backend(_tf.keras)
+
+# hvd.elastic under this namespace gets the SAME backend treatment: its
+# CommitState/UpdateBatchState callbacks must subclass tf.keras's
+# generation too, while KerasState/run are generation-neutral
+import types as _types  # noqa: E402
+
+from horovod_tpu.keras import elastic as _elastic_mod  # noqa: E402
+
+elastic = _types.SimpleNamespace(
+    **{k: getattr(_elastic_mod, k) for k in dir(_elastic_mod)
+       if not k.startswith("_")})
+elastic.CommitStateCallback = callbacks.CommitStateCallback
+elastic.UpdateBatchStateCallback = callbacks.UpdateBatchStateCallback

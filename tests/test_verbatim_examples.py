@@ -46,6 +46,10 @@ def _run_verbatim(tmp_path, rel_script, *args, timeout=900, env_extra=None):
         if k in env:
             worker_env += ["--env", f"{k}={env[k]}"]
     worker_env += ["--env", "PALLAS_AXON_POOL_IPS="]
+    # conftest exports XLA_FLAGS=--xla_force_host_platform_device_count=8
+    # for in-process tests; verbatim workers must see 1 chip per process
+    # so hvd.rank()/size() match the reference's process-rank math
+    worker_env += ["--env", "XLA_FLAGS="]
     p = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          *worker_env, sys.executable, script, *args],
@@ -99,3 +103,82 @@ def test_reference_tensorflow2_mnist_verbatim(tmp_path):
     assert "Step #" in out
     assert os.path.exists(os.path.join(str(tmp_path), "checkpoints-1.index")) or any(
         n.startswith("checkpoints") for n in os.listdir(str(tmp_path)))
+
+
+@needs_reference
+def test_reference_tensorflow2_keras_mnist_verbatim(tmp_path):
+    """reference examples/tensorflow2/tensorflow2_keras_mnist.py:17
+    `import horovod.tensorflow.keras as hvd` — unmodified under
+    TF_USE_LEGACY_KERAS=1 (the reference era's Keras-2 API:
+    `experimental_run_tf_function=False` compile kwarg, h5 checkpoints).
+    24 hardcoded epochs x 250 steps; the dataset shim keeps images 8x8."""
+    out = _run_verbatim(
+        tmp_path, "tensorflow2/tensorflow2_keras_mnist.py", timeout=900,
+        env_extra={"HVD_VERBATIM_MNIST_DIM": "8",
+                   "TF_USE_LEGACY_KERAS": "1"})
+    assert "Epoch 24/24" in out
+    # rank 0 wrote per-epoch h5 checkpoints
+    assert any(n.startswith("checkpoint-") and n.endswith(".h5")
+               for n in os.listdir(str(tmp_path)))
+
+
+@needs_reference
+def test_keras2_distributed_optimizer_actually_averages(tmp_path):
+    """The Keras-2 (tf_keras) wrap must intercept apply_gradients — a
+    wrong-funnel wrap trains without ever averaging, silently. Proof:
+    two ranks with rank-dependent data end one step with IDENTICAL
+    weights equal to the single-rank average."""
+    import subprocess
+    import textwrap
+
+    script = os.path.join(str(tmp_path), "w.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent("""
+            import os
+            os.environ["TF_USE_LEGACY_KERAS"] = "1"
+            # 1 chip per process: hvd.rank()/size() are chip-level
+            # (documented TPU semantics), and this test's analytic
+            # expectation assumes rank in {0, 1}
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import tensorflow as tf
+            import horovod.tensorflow.keras as hvd
+
+            hvd.init()
+            r = hvd.rank()
+            model = tf.keras.Sequential(
+                [tf.keras.layers.Dense(1, use_bias=False,
+                                       kernel_initializer="zeros",
+                                       input_shape=(2,))])
+            opt = hvd.DistributedOptimizer(tf.optimizers.SGD(0.5))
+            model.compile(optimizer=opt, loss="mse",
+                          experimental_run_tf_function=False)
+            # rank-dependent data -> rank-dependent local grads
+            x = np.full((4, 2), 1.0 + r, np.float32)
+            y = np.full((4, 1), 2.0 * (1.0 + r), np.float32)
+            model.fit(x, y, batch_size=4, epochs=1, verbose=0,
+                      callbacks=[hvd.callbacks
+                                 .BroadcastGlobalVariablesCallback(0)])
+            w = model.get_weights()[0].reshape(-1)
+            # local grad for rank r (w=0): d/dw mean((x.w - y)^2)
+            #   = 2*mean(x*(x.w - y)) = -2*(1+r)*2*(1+r) = -4(1+r)^2
+            # averaged grad = (-4 - 16)/2 = -10 -> w = 0.5*10 = 5 each
+            assert np.allclose(w, 5.0, atol=1e-4), w
+            others = hvd.allgather_object(w.tolist())
+            assert all(np.allclose(o, w) for o in others), others
+            print("K2-AVG-OK", r)
+        """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--env", "JAX_PLATFORMS=cpu", "--env", "TF_USE_LEGACY_KERAS=1",
+         "--env", "PYTHONPATH=" + env["PYTHONPATH"],
+         "--env", "PALLAS_AXON_POOL_IPS=", "--env", "XLA_FLAGS=",
+         sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-3000:])
+    assert p.stdout.count("K2-AVG-OK") == 2
