@@ -114,7 +114,10 @@ def build_callback_classes(keras):
             spe = self.steps_per_epoch or self.params.get("steps") or 1
             progress = (self._current_epoch * spe + batch + 1) / float(
                 self.warmup_epochs * spe)
-            base = self.initial_lr / max(_core.size(), 1)
+            # WORKER count, matching the shim's size()/LR-scaling
+            # convention (the user scaled initial_lr by hvd.size() =
+            # processes; dividing by chips would start warmup too low)
+            base = self.initial_lr / max(_core.cross_size(), 1)
             self._set_lr(base + (self.initial_lr - base) * min(progress, 1.0))
 
         def on_epoch_end(self, epoch, logs=None):

@@ -1,0 +1,47 @@
+"""Worker-level (process) topology for the framework shims.
+
+The framework shims' unit of data parallelism is the *process* — local
+chips form one logical worker and the eager collectives reduce across
+processes — so their ``rank()/size()/local_rank()/local_size()`` follow
+the reference's process semantics: a verbatim
+``DistributedSampler(num_replicas=hvd.size(), rank=hvd.rank())``
+partitions correctly on multi-chip hosts, and the reference invariant
+``local_size() <= size()`` holds (standalone, one process == one worker
+== its own host). Chip-level topology stays on the core JAX API
+(``horovod_tpu.rank()/size()/local_size()``).
+
+Defined ONCE here and imported by the torch/tensorflow/keras/mxnet
+shims (one semantic, four surfaces).
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import context as _ctx
+from . import env as _env
+
+
+def rank() -> int:
+    """Worker (process) rank — reference hvd.rank() semantics."""
+    return _ctx.cross_rank()
+
+
+def size() -> int:
+    """Worker (process) count — reference hvd.size() semantics."""
+    return _ctx.cross_size()
+
+
+def local_rank() -> int:
+    """This worker's rank among workers on the same host
+    (launcher-injected; standalone a single process is its host's only
+    worker, so 0 — NOT a chip index)."""
+    v = os.environ.get(_env.HOROVOD_LOCAL_RANK)
+    return int(v) if v is not None else 0
+
+
+def local_size() -> int:
+    """Workers on this host (launcher-injected; standalone 1, keeping
+    the reference invariant local_size() <= size())."""
+    v = os.environ.get(_env.HOROVOD_LOCAL_SIZE)
+    return int(v) if v is not None else 1

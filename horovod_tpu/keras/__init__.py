@@ -24,10 +24,16 @@ from horovod_tpu import (  # noqa: F401
     cross_size,
     init,
     is_initialized,
+    shutdown,
+)
+
+
+# worker-level (process) topology — reference shim semantics,
+# defined once in common/worker.py
+from horovod_tpu.common.worker import (  # noqa: F401
     local_rank,
     local_size,
     rank,
-    shutdown,
     size,
 )
 from horovod_tpu._keras import create_distributed_optimizer

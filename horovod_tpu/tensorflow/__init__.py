@@ -44,19 +44,25 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
     init,
     is_homogeneous,
     is_initialized,
-    local_rank,
-    local_size,
     mpi_built,
     mpi_enabled,
     nccl_built,
-    rank,
     remove_process_set,
     shutdown,
-    size,
     start_timeline,
     stop_timeline,
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
+
+
+# worker-level (process) topology — reference shim semantics,
+# defined once in common/worker.py
+from horovod_tpu.common.worker import (  # noqa: F401
+    local_rank,
+    local_size,
+    rank,
+    size,
+)
 
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401

@@ -38,10 +38,16 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
     cross_size,
     init,
     is_initialized,
+    shutdown,
+)
+
+
+# worker-level (process) topology — reference shim semantics,
+# defined once in common/worker.py
+from horovod_tpu.common.worker import (  # noqa: F401
     local_rank,
     local_size,
     rank,
-    shutdown,
     size,
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401

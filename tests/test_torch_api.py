@@ -340,3 +340,20 @@ def test_set_backward_passes_per_step():
     out = model(torch.randn(2, 3)).sum()
     out.backward()
     opt.step()  # bpps=1: hooks fire + sync immediately, no hang
+
+
+def test_shim_rank_size_are_process_level():
+    """Round 5: the framework shims report WORKER (process) rank/size —
+    reference semantics, so verbatim scripts partition data correctly on
+    multi-chip hosts — while the core API stays chip-level (this test
+    runs single-process over the 8-chip mesh: shim size()==1, core
+    size()==8)."""
+    import horovod_tpu
+    import horovod_tpu.keras as hvd_keras
+    import horovod_tpu.mxnet as hvd_mx
+    import horovod_tpu.tensorflow as hvd_tf
+
+    assert horovod_tpu.size() == 8  # chips (core semantics)
+    for shim in (hvd, hvd_tf, hvd_keras, hvd_mx):
+        assert shim.size() == horovod_tpu.cross_size() == 1
+        assert shim.rank() == horovod_tpu.cross_rank() == 0
