@@ -40,6 +40,15 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
     add_process_set,
     cross_rank,
     cross_size,
+    tpu_enabled,
+    tpu_built,
+    rocm_built,
+    mpi_threads_supported,
+    gloo_enabled,
+    gloo_built,
+    ddl_built,
+    cuda_built,
+    ccl_built,
     global_process_set,
     init,
     is_homogeneous,

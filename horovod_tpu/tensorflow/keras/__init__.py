@@ -12,8 +12,23 @@ from horovod_tpu.keras import (  # noqa: F401
     broadcast_object,
     broadcast_variables,
     elastic,
+    ccl_built,
     cross_rank,
     cross_size,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    is_homogeneous,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    start_timeline,
+    stop_timeline,
+    tpu_built,
+    tpu_enabled,
     init,
     is_initialized,
     load_model,

@@ -36,6 +36,21 @@ from horovod_tpu import (  # noqa: F401  (topology + lifecycle re-exports)
     Sum,
     cross_rank,
     cross_size,
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    is_homogeneous,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    start_timeline,
+    stop_timeline,
+    tpu_built,
+    tpu_enabled,
     init,
     is_initialized,
     shutdown,

@@ -182,3 +182,18 @@ def test_keras2_distributed_optimizer_actually_averages(tmp_path):
         env=env, capture_output=True, text=True, timeout=600)
     assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-3000:])
     assert p.stdout.count("K2-AVG-OK") == 2
+
+
+@needs_reference
+def test_reference_pytorch_mnist_verbatim_adasum_fp16(tmp_path):
+    """The reference script's own flag surface: --use-adasum exercises
+    the delta-Adasum torch optimizer and --fp16-allreduce the wire
+    compression, through the unmodified script."""
+    out = _run_verbatim(tmp_path, "pytorch/pytorch_mnist.py",
+                        "--epochs", "1", "--use-adasum",
+                        "--data-dir", str(tmp_path))
+    assert "Test set: Average loss" in out
+    out = _run_verbatim(tmp_path, "pytorch/pytorch_mnist.py",
+                        "--epochs", "1", "--fp16-allreduce",
+                        "--data-dir", str(tmp_path))
+    assert "Test set: Average loss" in out
