@@ -21,7 +21,9 @@ class KerasEstimator:
                  verbose: int = 1, backend_env: Optional[dict] = None,
                  label_dtype=None, staging_chunk_rows: int = 4096,
                  validation: Optional[float] = None,
-                 resume_from_checkpoint: bool = False):
+                 resume_from_checkpoint: bool = False,
+                 sample_weight_col: Optional[str] = None,
+                 custom_objects: Optional[dict] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -48,6 +50,12 @@ class KerasEstimator:
         # at initial_epoch)
         self.resume_from_checkpoint = resume_from_checkpoint
         self.history: dict = {}
+        # reference estimator params: per-row fit weights and the
+        # custom_objects dict for deserializing user layers/losses
+        # (reference keras estimator sample_weight_col /
+        # custom_objects)
+        self.sample_weight_col = sample_weight_col
+        self.custom_objects = dict(custom_objects or {})
         self._best_score = float("inf")  # best monitored loss so far
 
     def checkpoint_path(self) -> str:
@@ -114,7 +122,8 @@ class KerasEstimator:
             p = os.path.join(d, "model.keras")
             with open(p, "wb") as f:
                 f.write(data)
-            return keras.models.load_model(p)
+            return keras.models.load_model(
+                p, custom_objects=self.custom_objects or None)
 
     def _store_callbacks(self, hvd_keras=None, distributed=False) -> list:
         """Per-epoch checkpoint + best-model tracking as a Keras callback
@@ -172,11 +181,30 @@ class KerasEstimator:
         if self.store is not None:
             # store-backed path: stage through the Store, stream per-rank
             # chunks (reference spark/common/util.py:747 + petastorm)
+            if self.sample_weight_col:
+                raise ValueError(
+                    "sample_weight_col is supported on the in-memory "
+                    "(pandas) path; the store staging format carries "
+                    "features+labels only")
             return self._fit_from_store(df)
-        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols,
+        from .common.util import to_pandas
+
+        # collect ONCE (see spark/torch.py: a second toPandas() of an
+        # unordered plan can misalign weights with features)
+        pdf = to_pandas(df)
+        x, y = dataframe_to_numpy(pdf, self.feature_cols, self.label_cols,
                                   label_dtype=self.label_dtype)
+        w = None
+        if self.sample_weight_col:
+            import numpy as np
+
+            w = pdf[self.sample_weight_col].to_numpy(np.float32)
         if (self.num_proc and self.num_proc > 1
                 and "HOROVOD_RANK" not in os.environ):
+            if self.sample_weight_col:
+                raise ValueError(
+                    "sample_weight_col with estimator-launched num_proc "
+                    "is not supported; launch with hvdrun instead")
             return self._fit_multiproc(x, y)
 
         # under a launcher (hvdrun): data-parallel in-process fit — wrap
@@ -199,11 +227,13 @@ class KerasEstimator:
             self._compile_distributed(hvd_keras)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             x, y = x[r::n], y[r::n]
+            w = w[r::n] if w is not None else None
             callbacks = [
                 hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
                 hvd_keras.callbacks.MetricAverageCallback()]
         hist = self.model.fit(
             x, y, batch_size=self.batch_size, epochs=self.epochs,
+            sample_weight=w,
             validation_split=float(self.validation or 0.0),
             callbacks=callbacks, verbose=self.verbose)
         self.history = {k: [float(v) for v in vs]
@@ -366,7 +396,8 @@ class KerasEstimator:
             label_dtype=self.label_dtype,
             staging_chunk_rows=self.staging_chunk_rows,
             validation=self.validation,
-            resume_from_checkpoint=self.resume_from_checkpoint)
+            resume_from_checkpoint=self.resume_from_checkpoint,
+            custom_objects=self.custom_objects)
         store = self.store
 
         def worker(model_bytes, store, params):
@@ -382,7 +413,8 @@ class KerasEstimator:
                 p = os.path.join(d, "model.keras")
                 with open(p, "wb") as f:
                     f.write(model_bytes)
-                model = keras.models.load_model(p)
+                model = keras.models.load_model(
+                    p, custom_objects=params.get("custom_objects") or None)
             est = KerasEstimator(model=model, store=store, **params)
             est.fit(None)  # store path: reuses the staged chunks
             if hvd_keras.cross_rank() == 0:
@@ -424,7 +456,8 @@ class KerasEstimator:
                 model_bytes = f.read()
         cfg = dict(batch_size=self.batch_size, epochs=self.epochs,
                    verbose=self.verbose,
-                   validation=float(self.validation or 0.0))
+                   validation=float(self.validation or 0.0),
+                   custom_objects=self.custom_objects)
 
         def worker(model_bytes, x, y, cfg):
             import os
@@ -441,7 +474,8 @@ class KerasEstimator:
                     f.write(model_bytes)
                 # load_model re-wraps the deserialized optimizer as a
                 # DistributedOptimizer
-                model = hvd_keras.load_model(p)
+                model = hvd_keras.load_model(
+                    p, custom_objects=cfg["custom_objects"] or None)
             r, n = hvd_keras.cross_rank(), hvd_keras.cross_size()
             callbacks = [
                 hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),

@@ -67,7 +67,8 @@ class TorchEstimator:
                  backend_env: Optional[dict] = None,
                  label_dtype=None, staging_chunk_rows: int = 4096,
                  metrics: Optional[dict] = None,
-                 resume_from_checkpoint: bool = False):
+                 resume_from_checkpoint: bool = False,
+                 sample_weight_col: Optional[str] = None):
         self.num_proc = num_proc
         self.model = model
         self.optimizer = optimizer  # instance or factory(params)->optimizer
@@ -95,6 +96,10 @@ class TorchEstimator:
         # remote.py:141-143 state restore)
         self.resume_from_checkpoint = resume_from_checkpoint
         self.history: list = []
+        # per-row weight column (reference estimator sample_weight_col;
+        # remote.py calls loss_fn(outputs, labels, sample_weights)) —
+        # when set, ``loss`` must accept (output, target, weight)
+        self.sample_weight_col = sample_weight_col
 
     # -- checkpoints (Store-backed, reference spark/common/store.py) --------
     def checkpoint_path(self) -> str:
@@ -204,17 +209,27 @@ class TorchEstimator:
         def run_pass(batch_iter, train: bool, epoch: int) -> dict:
             total, steps = 0.0, 0
             msums = {name: 0.0 for name in self.metrics}
-            for xb, yb in batch_iter:
+            for batch in batch_iter:
+                xb, yb, *rest = batch
+                wb = rest[0] if rest else None
+
+                def compute_loss(out):
+                    # reference remote.py:398 train_minibatch calls
+                    # loss_fn(outputs, labels, sample_weights)
+                    if wb is None:
+                        return self.loss(out, yb)
+                    return self.loss(out, yb, wb)
+
                 if train:
                     opt.zero_grad()
                     out = self.model(xb)
-                    loss = self.loss(out, yb)
+                    loss = compute_loss(out)
                     loss.backward()
                     opt.step()
                 else:
                     with torch.no_grad():
                         out = self.model(xb)
-                        loss = self.loss(out, yb)
+                        loss = compute_loss(out)
                 total += float(loss.detach())
                 for name, fn in self.metrics.items():
                     with torch.no_grad():
@@ -275,16 +290,38 @@ class TorchEstimator:
             # store-backed path: stage through the Store, stream per-rank
             # chunks — the dataset is never materialized whole (reference
             # spark/common/util.py:747 prepare_data + petastorm readers)
+            if self.sample_weight_col:
+                raise ValueError(
+                    "sample_weight_col is supported on the in-memory "
+                    "(pandas) path; the store staging format carries "
+                    "features+labels only")
             return self._fit_from_store(df)
-        x, y = dataframe_to_numpy(df, self.feature_cols, self.label_cols,
+        from .common.util import to_pandas
+
+        # collect ONCE: a second toPandas() of an unordered pyspark plan
+        # could return rows in a different order and silently misalign
+        # the weights with their features
+        pdf = to_pandas(df)
+        x, y = dataframe_to_numpy(pdf, self.feature_cols, self.label_cols,
                                   label_dtype=self.label_dtype)
+        w = None
+        if self.sample_weight_col:
+            w = pdf[self.sample_weight_col].to_numpy(np.float32)
         (x, y), (x_val, y_val) = train_val_split(x, y, self.validation)
+        (w, _), (w_val, _) = train_val_split(w, None, self.validation) \
+            if w is not None else ((None, None), (None, None))
         if (self.num_proc and self.num_proc > 1
                 and "HOROVOD_RANK" not in os.environ):
             # estimator-launched distributed fit: spawn num_proc worker
             # processes (the reference estimator launches
             # horovod.spark.run the same way); each worker re-enters this
             # method with a live hvd world and takes the sharded branch
+            if self.sample_weight_col:
+                raise ValueError(
+                    "sample_weight_col with estimator-launched num_proc "
+                    "is not supported; launch the workers with hvdrun "
+                    "instead (the launcher-distributed path shards the "
+                    "weights with the data)")
             return self._fit_multiproc(x, y, x_val, y_val)
         opt = self._make_optimizer()
         import horovod_tpu.torch as hvd_torch
@@ -307,27 +344,39 @@ class TorchEstimator:
 
         xt = torch.from_numpy(np.ascontiguousarray(x))
         yt = torch.from_numpy(np.ascontiguousarray(y))
+        wt = (torch.from_numpy(np.ascontiguousarray(w))
+              if w is not None else None)
         if distributed:
             # each process trains its shard (reference: petastorm
             # row-group sharding per rank)
             r, n = hvd_torch.cross_rank(), hvd_torch.cross_size()
             xt, yt = xt[r::n], yt[r::n]
+            wt = wt[r::n] if wt is not None else None
 
         def train_batches(epoch):
             gen = torch.Generator().manual_seed(epoch)
             perm = torch.randperm(len(xt), generator=gen)
             for i in range(0, len(xt), self.batch_size):
                 idx = perm[i:i + self.batch_size]
-                yield xt[idx], yt[idx]
+                if wt is None:
+                    yield xt[idx], yt[idx]
+                else:
+                    yield xt[idx], yt[idx], wt[idx]
 
         val_batches = None
         if x_val is not None:
             xv = torch.from_numpy(np.ascontiguousarray(x_val))
             yv = torch.from_numpy(np.ascontiguousarray(y_val))
+            wv = (torch.from_numpy(np.ascontiguousarray(w_val))
+                  if w is not None and w_val is not None else None)
 
             def val_batches():
                 for i in range(0, len(xv), self.batch_size):
-                    yield xv[i:i + self.batch_size], yv[i:i + self.batch_size]
+                    sl = slice(i, i + self.batch_size)
+                    if wv is None:
+                        yield xv[sl], yv[sl]
+                    else:
+                        yield xv[sl], yv[sl], wv[sl]
 
         self._epoch_loop(opt, train_batches, val_batches, distributed,
                          hvd_torch)
