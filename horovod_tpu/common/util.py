@@ -23,3 +23,17 @@ def warn_64bit_narrowing(dtype) -> None:
             "disabled; TPUs have no float64 units). The caller dtype is "
             "restored on output but precision beyond 32 bits is lost. See "
             "docs/frameworks.md.", dtype)
+
+
+def module_namespace(mod, **extra):
+    """A SimpleNamespace copy of ``mod``'s public attributes with
+    framework-specific additions grafted on — used by the shims to
+    present ``hvd.elastic`` (etc.) with extra classes without mutating
+    the shared module."""
+    import types
+
+    ns = types.SimpleNamespace(
+        **{k: getattr(mod, k) for k in dir(mod) if not k.startswith("_")})
+    for k, v in extra.items():
+        setattr(ns, k, v)
+    return ns

@@ -189,6 +189,12 @@ class KerasEstimator:
             return self._fit_from_store(df)
         from .common.util import to_pandas
 
+        if (self.sample_weight_col and self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            # fail BEFORE the driver-side collect (see spark/torch.py)
+            raise ValueError(
+                "sample_weight_col with estimator-launched num_proc "
+                "is not supported; launch with hvdrun instead")
         # collect ONCE (see spark/torch.py: a second toPandas() of an
         # unordered plan can misalign weights with features)
         pdf = to_pandas(df)
@@ -201,10 +207,7 @@ class KerasEstimator:
             w = pdf[self.sample_weight_col].to_numpy(np.float32)
         if (self.num_proc and self.num_proc > 1
                 and "HOROVOD_RANK" not in os.environ):
-            if self.sample_weight_col:
-                raise ValueError(
-                    "sample_weight_col with estimator-launched num_proc "
-                    "is not supported; launch with hvdrun instead")
+            # (sample_weight_col was rejected before the collect above)
             return self._fit_multiproc(x, y)
 
         # under a launcher (hvdrun): data-parallel in-process fit — wrap

@@ -298,6 +298,16 @@ class TorchEstimator:
             return self._fit_from_store(df)
         from .common.util import to_pandas
 
+        if (self.sample_weight_col and self.num_proc and self.num_proc > 1
+                and "HOROVOD_RANK" not in os.environ):
+            # fail BEFORE the driver-side collect (all inputs to this
+            # check are known already; collecting GBs first would waste
+            # the most expensive step)
+            raise ValueError(
+                "sample_weight_col with estimator-launched num_proc "
+                "is not supported; launch the workers with hvdrun "
+                "instead (the launcher-distributed path shards the "
+                "weights with the data)")
         # collect ONCE: a second toPandas() of an unordered pyspark plan
         # could return rows in a different order and silently misalign
         # the weights with their features
@@ -316,12 +326,7 @@ class TorchEstimator:
             # processes (the reference estimator launches
             # horovod.spark.run the same way); each worker re-enters this
             # method with a live hvd world and takes the sharded branch
-            if self.sample_weight_col:
-                raise ValueError(
-                    "sample_weight_col with estimator-launched num_proc "
-                    "is not supported; launch the workers with hvdrun "
-                    "instead (the launcher-distributed path shards the "
-                    "weights with the data)")
+            # (sample_weight_col was rejected before the collect above)
             return self._fit_multiproc(x, y, x_val, y_val)
         opt = self._make_optimizer()
         import horovod_tpu.torch as hvd_torch

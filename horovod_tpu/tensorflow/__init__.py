@@ -695,3 +695,15 @@ class _LegacyDistributedOptimizer(tf.compat.v1.train.Optimizer):
 
     def variables(self, *args, **kwargs):
         return self._opt.variables(*args, **kwargs)
+
+
+# hvd.elastic under the tensorflow namespace carries the TF state
+# classes next to run (reference horovod/tensorflow/elastic.py exposes
+# TensorFlowState/TensorFlowKerasState; verbatim scripts call
+# `hvd.elastic.TensorFlowKerasState(model, opt, batch=0)`)
+from horovod_tpu.common.util import module_namespace as _module_ns  # noqa: E402
+
+from .elastic import TensorFlowKerasState, TensorFlowState  # noqa: E402,F401
+
+elastic = _module_ns(_elastic, TensorFlowState=TensorFlowState,
+                     TensorFlowKerasState=TensorFlowKerasState)

@@ -41,12 +41,31 @@ class TensorFlowState(ObjectState):
 
 
 class TensorFlowKerasState(TensorFlowState):
-    """Model+optimizer variant (reference TensorFlowKerasState)."""
+    """Model+optimizer variant (reference TensorFlowKerasState).
+
+    The tracked variable list is RE-COLLECTED at every save()/sync():
+    Keras creates optimizer slot variables (momentum, Adam moments)
+    lazily at the first apply step, and a list frozen at construction
+    would silently exclude them from snapshots and broadcasts."""
 
     def __init__(self, model, optimizer=None, **kwargs):
         self.model = model
         self.optimizer = optimizer or getattr(model, "optimizer", None)
-        variables = list(model.variables)
+        super().__init__(variables=None, **kwargs)
+
+    def _collect(self):
+        variables = list(self.model.variables)
         if self.optimizer is not None:
-            variables += list(getattr(self.optimizer, "variables", []) or [])
-        super().__init__(variables=variables, **kwargs)
+            ovars = getattr(self.optimizer, "variables", None)
+            if callable(ovars) and not hasattr(ovars, "__iter__"):
+                ovars = ovars()  # Keras-2 optimizer_v2: variables() method
+            variables += list(ovars or [])
+        return variables
+
+    def save(self):
+        self._variables = self._collect()
+        super().save()
+
+    def sync(self):
+        self._variables = self._collect()
+        super().sync()

@@ -56,12 +56,10 @@ callbacks = _cb_for_backend(_tf.keras)
 # hvd.elastic under this namespace gets the SAME backend treatment: its
 # CommitState/UpdateBatchState callbacks must subclass tf.keras's
 # generation too, while KerasState/run are generation-neutral
-import types as _types  # noqa: E402
-
+from horovod_tpu.common.util import module_namespace as _module_ns  # noqa: E402
 from horovod_tpu.keras import elastic as _elastic_mod  # noqa: E402
 
-elastic = _types.SimpleNamespace(
-    **{k: getattr(_elastic_mod, k) for k in dir(_elastic_mod)
-       if not k.startswith("_")})
-elastic.CommitStateCallback = callbacks.CommitStateCallback
-elastic.UpdateBatchStateCallback = callbacks.UpdateBatchStateCallback
+elastic = _module_ns(
+    _elastic_mod,
+    CommitStateCallback=callbacks.CommitStateCallback,
+    UpdateBatchStateCallback=callbacks.UpdateBatchStateCallback)

@@ -851,6 +851,35 @@ class TorchState(ObjectState):
         self._sampler_saved = None
         super().__init__(**kwargs)
 
+    # public handles (reference TorchState: verbatim scripts drive
+    # state.model / state.optimizer / state.sampler directly, and may
+    # REASSIGN them after a reset) — property-backed so a reassignment
+    # stays attached to save/restore/sync instead of silently training
+    # an object the snapshots never see
+    @property
+    def model(self):
+        return self._model
+
+    @model.setter
+    def model(self, m):
+        self._model = m
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @optimizer.setter
+    def optimizer(self, o):
+        self._optimizer = o
+
+    @property
+    def sampler(self):
+        return self._sampler
+
+    @sampler.setter
+    def sampler(self, s):
+        self._sampler = s
+
     def save(self):
         if self._model is not None:
             self._model_saved = {k: v.detach().clone()
@@ -886,3 +915,15 @@ class TorchState(ObjectState):
             st["processed_indices"] = sorted(merged)
             self._sampler.load_state_dict(st)
         super().sync()
+
+
+# hvd.elastic under the torch namespace carries the torch-specific state
+# classes too (reference horovod/torch/elastic/__init__.py exposes
+# TorchState + ElasticSampler next to run): a verbatim
+# `hvd.elastic.TorchState(model, optimizer, ...)` must resolve. Built as
+# a namespace copy so the shared horovod_tpu.elastic module stays
+# framework-neutral.
+from horovod_tpu.common.util import module_namespace as _module_ns  # noqa: E402
+
+elastic = _module_ns(elastic, TorchState=TorchState,
+                     ElasticSampler=ElasticSampler)

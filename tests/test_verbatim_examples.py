@@ -197,3 +197,29 @@ def test_reference_pytorch_mnist_verbatim_adasum_fp16(tmp_path):
                         "--epochs", "1", "--fp16-allreduce",
                         "--data-dir", str(tmp_path))
     assert "Test set: Average loss" in out
+
+
+@needs_reference
+def test_reference_pytorch_mnist_elastic_verbatim(tmp_path):
+    """reference examples/elastic/pytorch/pytorch_mnist_elastic.py —
+    `@hvd.elastic.run` + `hvd.elastic.TorchState(model, optimizer,
+    epoch=1, batch=0)` driving state.model/state.optimizer publicly,
+    with per-batch state.commit(); unmodified under a static -np 2
+    launch (the elastic wrapper is world-size-agnostic)."""
+    out = _run_verbatim(tmp_path, "elastic/pytorch/pytorch_mnist_elastic.py",
+                        "--epochs", "1", "--data-dir", str(tmp_path))
+    assert "Test set: Average loss" in out
+
+
+@needs_reference
+def test_reference_tensorflow2_mnist_elastic_verbatim(tmp_path):
+    """reference examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py
+    — `hvd.elastic.TensorFlowKerasState(model, opt, batch=0)` + the
+    traced DistributedGradientTape step with per-10-batch commits;
+    unmodified (legacy keras: the script uses opt.lr.assign)."""
+    out = _run_verbatim(
+        tmp_path, "elastic/tensorflow2/tensorflow2_mnist_elastic.py",
+        timeout=1200,
+        env_extra={"HVD_VERBATIM_MNIST_DIM": "8",
+                   "TF_USE_LEGACY_KERAS": "1"})
+    assert "Step #" in out
