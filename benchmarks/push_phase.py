@@ -1,0 +1,79 @@
+"""Post-sweep push: configs around the round-5 winner (batch 256 /
+scan 8 / space-to-depth = 32.1% MFU) that the resnet and sweep phases
+did not cover — deeper scan at the winning stem and intermediate
+batches. Each result appends to mfu_results.jsonl; a new winner updates
+bench_tuned.json so the driver's bench run inherits it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from _common import (enable_compilation_cache, make_recorder,
+                     require_tpu, start_stall_watchdog,
+                     write_tuned_if_better)
+
+record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "mfu_results.jsonl"))
+
+
+def main():
+    import horovod_tpu as hvd
+    from bench import (RESNET50_FWD_FLOP_PER_IMG as FWD,
+                       TRAIN_FLOP_MULT, bench_resnet, chip_peak_flops)
+    from horovod_tpu.models import ResNet50
+
+    enable_compilation_cache()
+    start_stall_watchdog(900)
+    require_tpu()
+    hvd.init()
+    PEAK = chip_peak_flops()
+    record(event="push_start", device=jax.devices()[0].device_kind)
+
+    def model(s2d):
+        return lambda: ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                                space_to_depth=s2d)
+
+    best = None
+    wedged = False
+    for batch, scan, s2d in ((256, 16, True), (256, 32, True),
+                             (384, 8, True), (320, 16, True),
+                             (512, 16, True)):
+        try:
+            ips = bench_resnet(batch, warmup=2, iters=4, scan_steps=scan,
+                               model_fn=model(s2d))
+            record(event="resnet_push", batch=batch, scan=scan, s2d=s2d,
+                   img_s=round(ips, 1),
+                   mfu=round(ips * FWD * TRAIN_FLOP_MULT / PEAK, 4))
+            if best is None or ips > best[0]:
+                best = (ips, batch, scan, s2d)
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            record(event="resnet_push_error", batch=batch, scan=scan,
+                   error=msg[:200])
+            if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                continue  # OOM is conclusive for this config; try the rest
+            # anything else is likely a tunnel wedge: stop burning the
+            # window, bank what we have, and exit nonzero below so the
+            # next uptime window retries the unmeasured configs
+            # (completed compiles are in .jax_cache, so the retry is
+            # measurement-only)
+            wedged = True
+            break
+
+    if best is not None:
+        written, prev = write_tuned_if_better(
+            {"batch": best[1], "scan_steps": best[2], "conv_impl": "native",
+             "s2d": best[3], "img_s": round(best[0], 1)})
+        record(event="push_tuned" if written else "push_kept_existing",
+               img_s=round(best[0], 1), existing=prev)
+    if wedged or best is None:
+        sys.exit(4 if wedged else 3)
+
+
+if __name__ == "__main__":
+    main()
