@@ -75,6 +75,49 @@ def test_filesystem_store_layout_and_io(tmp_path):
     assert not store.exists(store.get_logs_path("run7"))
 
 
+def test_filesystem_store_concurrent_same_path(tmp_path):
+    """Concurrent write_bytes to ONE path must never crash or leave a
+    torn file — every hvdrun worker stages the same chunk files to the
+    shared store (keras.py _fit_from_store), which with a shared tmp
+    name raced to FileNotFoundError on the second os.replace. Fresh
+    subprocesses (not fork: the pytest process has live XLA threads)
+    mirror the real racing-workers topology."""
+    import subprocess
+
+    store_dir = str(tmp_path / "st")
+    target = os.path.join(store_dir, "chunk_000000.parquet")
+    script = (
+        "import sys\n"
+        "from horovod_tpu.spark.common.store import FilesystemStore\n"
+        "i = int(sys.argv[1])\n"
+        f"s = FilesystemStore({store_dir!r})\n"
+        f"s.write_bytes({target!r}, bytes([i]) * (1 << 20))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(i)],
+                              env=env, stderr=subprocess.PIPE, text=True)
+             for i in range(8)]
+    errs = [(p.wait(timeout=120), p.stderr.read()) for p in procs]
+    assert all(rc == 0 for rc, _ in errs), errs
+    # intact single-writer payload, no interleaving, no leftover tmps
+    payloads = [bytes([i]) * (1 << 20) for i in range(8)]
+    assert FilesystemStore(store_dir).read_bytes(target) in payloads
+    left = [f for f in os.listdir(store_dir) if ".tmp" in f]
+    assert not left, left
+    # plain-open() permissions survive the mkstemp tmp (0600) — shared
+    # stores are read across uids
+    mode = os.stat(target).st_mode & 0o777
+    import stat as _stat
+    assert mode & _stat.S_IRUSR and mode == (0o666 & ~_get_umask())
+
+
+def _get_umask():
+    import os as _os
+
+    cur = _os.umask(0)
+    _os.umask(cur)
+    return cur
+
+
 def test_keras_estimator_checkpoint_roundtrip(tmp_path):
     """Estimator checkpoints ride the Store (reference spark/keras
     estimator save/load path) — no Spark needed for the artifact layer."""
