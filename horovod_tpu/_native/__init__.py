@@ -25,11 +25,17 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO + ".tmp", _SRC, "-lpthread"]
+    # N launcher workers on one host all build on first use; the shared
+    # atomic-replace helper keeps concurrent g++ runs from truncating
+    # each other's output (0o777: .so keeps exec bits under the umask)
+    from ..common.util import atomic_tmp
+
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
+        with atomic_tmp(_SO, mode=0o777) as tmp:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, _SRC, "-lpthread"],
+                check=True, capture_output=True, timeout=120)
         return True
     except Exception as e:
         LOG.debug("native core build failed (%s); using numpy fallback", e)

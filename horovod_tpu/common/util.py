@@ -2,9 +2,61 @@
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
+import tempfile
 
 LOG = logging.getLogger("horovod_tpu")
+
+# process umask, read once at import (single-threaded) — os.umask() is
+# process-global and racy to query from concurrent writers
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+@contextlib.contextmanager
+def atomic_tmp(path: str, mode: int | None = 0o666):
+    """Yield a unique tmp filename next to ``path``; atomically commit it
+    over ``path`` on clean exit, remove it on error.
+
+    The single atomic-replace implementation for every concurrent writer
+    in the runtime (store chunks, pickle checkpoints, the native-lib
+    build): N launcher workers write the same artifact simultaneously, so
+    tmp names must be per-call unique (a shared name lets one worker
+    truncate the file another is mid-writing and makes the loser's
+    ``os.replace`` fail with FileNotFoundError) and the tmp must live in
+    the target's directory so the rename stays on one filesystem.
+    ``mode`` restores plain-``open()`` permissions at commit (mkstemp
+    creates 0600; shared stores are read across uids) — best-effort, and
+    ``None`` keeps the tmp's mode.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path),
+                               suffix=".tmp")
+    os.close(fd)
+    try:
+        yield tmp
+        if mode is not None:
+            try:
+                os.chmod(tmp, mode & ~_UMASK)
+            except OSError:  # e.g. some CIFS/FUSE mounts — keep the write
+                pass
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes, mode: int | None = 0o666):
+    """Concurrency-safe whole-file write via :func:`atomic_tmp`."""
+    with atomic_tmp(path, mode=mode) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(data)
 
 _warned_64bit = False
 

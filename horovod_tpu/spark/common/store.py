@@ -13,13 +13,9 @@ from __future__ import annotations
 
 import os
 import shutil
-import tempfile
 from typing import Optional
 
-# process umask, read once at import (single-threaded) — os.umask() is
-# process-global and racy to query from concurrent writers
-_UMASK = os.umask(0)
-os.umask(_UMASK)
+from ...common.util import atomic_write_bytes
 
 
 class Store:
@@ -96,30 +92,10 @@ class FilesystemStore(Store):
             return f.read()
 
     def write_bytes(self, path: str, data: bytes):
-        # Unique tmp per call: every hvdrun worker stages the same chunks
-        # to the same store concurrently (keras.py _fit_from_store), so a
-        # shared "<path>.tmp" lets rank A truncate the file rank B is
-        # mid-writing and makes B's os.replace fail with FileNotFoundError
-        # once A renamed it away. mkstemp in the target dir keeps the
-        # rename atomic on the same filesystem; last writer wins with an
-        # intact payload.
-        d = os.path.dirname(path)
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path),
-                                   suffix=".tmp")
-        try:
-            # mkstemp creates 0600; restore the plain-open() mode so
-            # shared-store readers under another uid/gid keep working
-            os.fchmod(fd, 0o666 & ~_UMASK)
-            with os.fdopen(fd, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # Every hvdrun worker stages the same chunks to the same store
+        # concurrently (keras.py _fit_from_store): last intact writer
+        # wins via the shared atomic-replace helper.
+        atomic_write_bytes(path, data)
 
     def cleanup_run(self, run_id: str):
         shutil.rmtree(self.get_run_path(run_id), ignore_errors=True)

@@ -70,10 +70,11 @@ def save_pytree(path: str, tree: Any, *, format: str = "pickle"):
         return
     if format != "pickle":
         raise ValueError(f"unknown checkpoint format {format!r}")
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(tree, f)
-    os.replace(tmp, path)
+    from ..common.util import atomic_tmp
+
+    with atomic_tmp(path) as tmp:
+        with open(tmp, "wb") as f:
+            pickle.dump(tree, f)
 
 
 def _resolve(path: str) -> str:

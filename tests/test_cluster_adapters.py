@@ -96,8 +96,11 @@ def test_filesystem_store_concurrent_same_path(tmp_path):
     procs = [subprocess.Popen([sys.executable, "-c", script, str(i)],
                               env=env, stderr=subprocess.PIPE, text=True)
              for i in range(8)]
-    errs = [(p.wait(timeout=120), p.stderr.read()) for p in procs]
-    assert all(rc == 0 for rc, _ in errs), errs
+    # communicate (not wait+read): drains the pipe so a chatty child
+    # can't fill the 64KB stderr buffer and deadlock against wait()
+    errs = [(p, p.communicate(timeout=120)[1]) for p in procs]
+    assert all(p.returncode == 0 for p, _ in errs), \
+        [(p.returncode, e[-300:]) for p, e in errs]
     # intact single-writer payload, no interleaving, no leftover tmps
     payloads = [bytes([i]) * (1 << 20) for i in range(8)]
     assert FilesystemStore(store_dir).read_bytes(target) in payloads
