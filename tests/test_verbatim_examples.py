@@ -123,6 +123,37 @@ def test_reference_tensorflow2_keras_mnist_verbatim(tmp_path):
 
 
 @needs_reference
+def test_reference_tf2_synthetic_benchmark_verbatim(tmp_path):
+    """reference examples/tensorflow2/tensorflow2_synthetic_benchmark.py
+    — the reference's OWN perf-measurement harness (BASELINE.md's
+    in-repo harness row) — unmodified, 2 processes. Only injections:
+    the sitecustomize Keras-version compat patch (``opt.variables()``
+    was a method in the script's TF era, a property now; fails
+    identically against original Horovod on this TF) and tiny sizes via
+    its own CLI flags."""
+    out = _run_verbatim(
+        tmp_path, "tensorflow2/tensorflow2_synthetic_benchmark.py",
+        "--model", "MobileNetV2", "--batch-size", "4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "2", timeout=900)
+    assert "Total img/sec on 2" in out
+
+
+@needs_reference
+def test_reference_tf2_keras_synthetic_benchmark_verbatim(tmp_path):
+    """reference examples/tensorflow2/tensorflow2_keras_synthetic_
+    benchmark.py — DistributedOptimizer(compression=) + callbacks on
+    model.fit — unmodified, 2 processes (sitecustomize swallows the
+    TF-2.0-era ``experimental_run_tf_function`` compile kwarg that TF
+    itself removed in 2.4)."""
+    out = _run_verbatim(
+        tmp_path, "tensorflow2/tensorflow2_keras_synthetic_benchmark.py",
+        "--model", "MobileNetV2", "--batch-size", "4",
+        "--num-batches-per-iter", "1", "--num-iters", "2", timeout=900)
+    assert "Total img/sec on 2" in out
+
+
+@needs_reference
 def test_keras2_distributed_optimizer_actually_averages(tmp_path):
     """The Keras-2 (tf_keras) wrap must intercept apply_gradients — a
     wrong-funnel wrap trains without ever averaging, silently. Proof:

@@ -1,17 +1,24 @@
-"""Dataset-download shims for running the REFERENCE examples verbatim.
+"""ENVIRONMENT shims for running the REFERENCE examples verbatim.
 
 The north-star contract (SURVEY.md §7 step 3) is that reference user
-scripts — e.g. reference examples/tensorflow2/tensorflow2_mnist.py:29,
-which calls ``tf.keras.datasets.mnist.load_data`` — run **unmodified**
-against the ``horovod`` alias package. This image has zero egress, so
-the one thing we may inject is the dataset download itself: this
+scripts run **unmodified** against the ``horovod`` alias package. This
 sitecustomize (put on PYTHONPATH only by tests/test_verbatim_examples.py)
-installs a post-import patch that replaces keras's MNIST ``load_data``
-with a synthetic in-memory generator. No horovod/model/step code is
-touched.
+injects compensation for exactly two properties of this image, neither
+of them horovod behavior:
 
-It also chain-loads the system sitecustomize it shadows (the axon TPU
-plugin hook), since Python imports only the first one found.
+- **zero egress**: keras's MNIST ``load_data`` (reference
+  tensorflow2_mnist.py:29) is replaced with a synthetic in-memory
+  generator, and a torchvision stand-in package is provided;
+- **Keras/TF version skew**: the reference's 2019-era synthetic
+  benchmarks use APIs TF itself later changed — ``opt.variables()``
+  as a method and the ``experimental_run_tf_function`` compile kwarg
+  (removed in TF 2.4). Two patches restore those spellings; the
+  scripts fail identically against ORIGINAL Horovod on this TF
+  without them.
+
+No horovod/model/step code is touched. It also chain-loads the system
+sitecustomize it shadows (the axon TPU plugin hook), since Python
+imports only the first one found.
 """
 
 import importlib.abc
@@ -20,12 +27,45 @@ import importlib.util
 import os
 import sys
 
-_TARGETS = {
-    "keras.datasets.mnist", "keras.src.datasets.mnist",
-    # legacy-keras spellings (TF_USE_LEGACY_KERAS=1 → tf.keras is
-    # tf_keras, matching the reference's Keras-2-era API)
-    "tf_keras.datasets.mnist", "tf_keras.src.datasets.mnist",
-}
+def _patch_optimizer_variables(module):
+    """Keras-VERSION compat (not horovod logic): the reference's
+    2019-era synthetic benchmarks call ``opt.variables()``
+    (tensorflow2_synthetic_benchmark.py:94) — a method on TF≤2.10-era
+    optimizers, a plain list property in Keras 3. Make the property's
+    value answer both spellings. The same scripts fail identically
+    against original Horovod on this TF; this shim is about the image's
+    TF version, exactly like the dataset-download shims are about its
+    zero egress."""
+    base = getattr(module, "BaseOptimizer", None)
+    if base is None:
+        return
+    orig = base.__dict__.get("variables")
+    if not isinstance(orig, property):
+        return
+
+    class _CallableList(list):
+        def __call__(self):
+            return list(self)
+
+    base.variables = property(lambda self: _CallableList(orig.fget(self)))
+
+
+def _patch_compile_legacy_kwarg(module):
+    """Keras-VERSION compat: the reference's Keras synthetic benchmark
+    passes ``experimental_run_tf_function=False`` to ``model.compile``
+    (tensorflow2_keras_synthetic_benchmark.py:84) — a TF-2.0-era kwarg
+    that TF itself removed in 2.4; Keras 3 raises TypeError on it.
+    Swallow exactly that kwarg, nothing else."""
+    trainer = getattr(module, "Trainer", None)
+    if trainer is None:
+        return
+    orig = trainer.compile
+
+    def compile(self, *args, **kwargs):
+        kwargs.pop("experimental_run_tf_function", None)
+        return orig(self, *args, **kwargs)
+
+    trainer.compile = compile
 
 
 def _synthetic_mnist_load_data(path="mnist.npz"):
@@ -49,9 +89,22 @@ def _patch(module):
     module.load_data = _synthetic_mnist_load_data
 
 
+_TARGETS = {
+    "keras.datasets.mnist": _patch,
+    "keras.src.datasets.mnist": _patch,
+    # legacy-keras spellings (TF_USE_LEGACY_KERAS=1 → tf.keras is
+    # tf_keras, matching the reference's Keras-2-era API)
+    "tf_keras.datasets.mnist": _patch,
+    "tf_keras.src.datasets.mnist": _patch,
+    "keras.src.optimizers.base_optimizer": _patch_optimizer_variables,
+    "keras.src.trainers.trainer": _patch_compile_legacy_kwarg,
+}
+
+
 class _PatchingLoader(importlib.abc.Loader):
-    def __init__(self, wrapped):
+    def __init__(self, wrapped, patch):
         self._wrapped = wrapped
+        self._patch = patch
 
     def __getattr__(self, name):
         return getattr(self._wrapped, name)
@@ -61,7 +114,7 @@ class _PatchingLoader(importlib.abc.Loader):
 
     def exec_module(self, module):
         self._wrapped.exec_module(module)
-        _patch(module)
+        self._patch(module)
 
 
 class _MnistShimFinder(importlib.abc.MetaPathFinder):
@@ -75,7 +128,7 @@ class _MnistShimFinder(importlib.abc.MetaPathFinder):
             sys.meta_path.insert(0, self)
         if spec is None or spec.loader is None:
             return None
-        spec.loader = _PatchingLoader(spec.loader)
+        spec.loader = _PatchingLoader(spec.loader, _TARGETS[fullname])
         return spec
 
 
