@@ -140,6 +140,22 @@ def test_reference_tf2_synthetic_benchmark_verbatim(tmp_path):
 
 
 @needs_reference
+def test_reference_pytorch_synthetic_benchmark_verbatim(tmp_path):
+    """reference examples/pytorch/pytorch_synthetic_benchmark.py —
+    DistributedOptimizer(named_parameters, compression, op) + both
+    broadcasts on a real torch ResNet-50 — unmodified, 2 processes.
+    torchvision is uninstallable here (zero egress), so the stand-in
+    provides an independent implementation of the architecture
+    (canonical 25,557,032 params, tests/verbatim_support/torchvision/
+    models.py)."""
+    out = _run_verbatim(
+        tmp_path, "pytorch/pytorch_synthetic_benchmark.py",
+        "--batch-size", "2", "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1", "--num-iters", "2", timeout=900)
+    assert "Total img/sec on 2" in out
+
+
+@needs_reference
 def test_reference_tf2_keras_synthetic_benchmark_verbatim(tmp_path):
     """reference examples/tensorflow2/tensorflow2_keras_synthetic_
     benchmark.py — DistributedOptimizer(compression=) + callbacks on

@@ -8,6 +8,6 @@ this shim provides the same surface backed by deterministic synthetic
 data. It is on PYTHONPATH only for tests/test_verbatim_examples.py.
 """
 
-from . import datasets, transforms  # noqa: F401
+from . import datasets, models, transforms  # noqa: F401
 
 __version__ = "0.0.0+hvd-tpu-verbatim-shim"

@@ -1,0 +1,81 @@
+"""``torchvision.models`` stand-in: an independent torch ResNet-50.
+
+The reference's pytorch_synthetic_benchmark.py:47 does
+``getattr(models, args.model)()`` purely as a FLOP source — the script's
+*horovod* surface is DistributedOptimizer(named_parameters, compression,
+op) + broadcast_parameters/broadcast_optimizer_state. torchvision ships
+CUDA-linked wheels and cannot be installed in this zero-egress image, so
+this module provides the standard ResNet-50 architecture (bottleneck
+blocks, [3,4,6,3]) written directly against torch.nn — an independent
+implementation, not torchvision code.
+"""
+
+import torch.nn as nn
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1, downsample=None):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + idn)
+
+
+class ResNet(nn.Module):
+    def __init__(self, layers, num_classes=1000):
+        super().__init__()
+        self.cin = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU(inplace=True)
+        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(64, layers[0], 1)
+        self.layer2 = self._make_layer(128, layers[1], 2)
+        self.layer3 = self._make_layer(256, layers[2], 2)
+        self.layer4 = self._make_layer(512, layers[3], 2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512 * Bottleneck.expansion, num_classes)
+
+    def _make_layer(self, width, blocks, stride):
+        cout = width * Bottleneck.expansion
+        down = None
+        if stride != 1 or self.cin != cout:
+            down = nn.Sequential(nn.Conv2d(self.cin, cout, 1, stride=stride,
+                                           bias=False), nn.BatchNorm2d(cout))
+        mods = [Bottleneck(self.cin, width, stride, down)]
+        self.cin = cout
+        mods += [Bottleneck(cout, width) for _ in range(1, blocks)]
+        return nn.Sequential(*mods)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.fc(self.avgpool(x).flatten(1))
+
+
+def resnet50(**kw):
+    return ResNet([3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw):
+    return ResNet([3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw):
+    return ResNet([3, 8, 36, 3], **kw)
