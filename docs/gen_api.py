@@ -1,5 +1,8 @@
 """Generate docs/api.md from module docstrings (run on CPU)."""
 import os
+import sys
+# importable without the editable install (script dir is docs/, not repo)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax; jax.config.update("jax_platforms", "cpu")
@@ -35,6 +38,8 @@ for name, title in MODULES:
     try:
         mod = importlib.import_module(name)
     except Exception as e:
+        print(f"WARNING: skipping {name}: {type(e).__name__}: {e}",
+              file=sys.stderr)
         continue
     out.append(f"## `{name}` — {title}")
     out.append("")
