@@ -278,53 +278,9 @@ def main():
     quick = "--quick" in sys.argv  # CPU/CI smoke: tiny sizes
     # defaults come from the last MFU campaign on this machine when
     # available (benchmarks/mfu_campaign.py writes the winning config);
-    # env vars always win. The in-code defaults equal the round-5 on-chip
-    # winner (batch 256, scan 8, space-to-depth stem — 32.1% MFU,
-    # benchmarks/chip_evidence_r5/mfu_results_r5.jsonl) so a fresh
-    # container with no bench_tuned.json still measures the winner.
-    tuned_batch, tuned_scan = 256, 8
-    tuned_s2d = None       # None = no tuned-file opinion; resolved below
-    tuned_file_read = False
-    if _bench_model_name() != "resnet50":
-        # the tuned file was swept FOR resnet50; a deeper model at that
-        # batch risks burning a chip window on an OOM — start from a
-        # conservative default (env vars still override)
-        tuned_batch, tuned_scan = 128, 4
-    # per-machine file: only honored in single-process runs — multi-host
-    # ranks could read different local files and submit mismatched
-    # collective shapes (env vars are launcher-propagated, so they stay
-    # the cross-process path)
-    if hvd.cross_size() <= 1 and _bench_model_name() == "resnet50":
-        try:
-            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "benchmarks", "bench_tuned.json")) as f:
-                tuned = json.load(f)
-            tuned_file_read = True
-            tuned_batch = int(tuned.get("batch", tuned_batch))
-            tuned_scan = int(tuned.get("scan_steps", tuned_scan))
-            if "s2d" in tuned:
-                # a campaign-written opinion (True OR False) always wins
-                # over the in-code default
-                tuned_s2d = bool(tuned["s2d"])
-            if tuned.get("conv_impl") and not quick:
-                # campaign found the conv-free im2col lowering faster on
-                # this platform (benchmarks/probe_conv.py)
-                os.environ.setdefault("HVD_BENCH_CONV_IMPL",
-                                      str(tuned["conv_impl"]))
-        except Exception:
-            pass
-    if (_bench_model_name() == "resnet50" and tuned_s2d is None
-            and not tuned_file_read):
-        # no tuned file on this machine: fall back to the round-5 on-chip
-        # winner (space-to-depth stem). resnet50-only — the sweep that
-        # picked it ran on resnet50. A tuned file WITHOUT an s2d key
-        # keeps the standard stem its own sweep used (pre-r5 files).
-        # Deterministic across ranks, so safe outside the cross_size
-        # guard (quick/CI smoke keeps the standard stem, like it keeps
-        # its own batch/scan).
-        tuned_s2d = True
-    if tuned_s2d and not quick:
-        os.environ.setdefault("HVD_BENCH_S2D", "1")
+    # env vars always win
+    tuned_batch, tuned_scan = _resolve_tuned_config(
+        quick, single_process=hvd.cross_size() <= 1)
     per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else tuned_batch)
     scan_steps = _sync_int_env("HVD_BENCH_SCAN_STEPS",
                                1 if quick else tuned_scan)
@@ -392,6 +348,64 @@ def main():
         "vs_baseline": round(per_chip_ips / BASELINE_PER_DEVICE, 3),
         "extras": extras,
     }))
+
+
+_TUNED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "bench_tuned.json")
+
+
+def _resolve_tuned_config(quick: bool, single_process: bool,
+                          tuned_path: str = _TUNED_PATH):
+    """Resolve the batch/scan defaults and apply stem/lowering env
+    defaults (``HVD_BENCH_S2D`` / ``HVD_BENCH_CONV_IMPL``).
+
+    Precedence: env vars (launcher-propagated; always win — applied via
+    ``setdefault`` here and ``_sync_int_env`` by the caller)
+    > campaign-written ``bench_tuned.json`` (single-process resnet50
+    only: per-machine files could hand multi-host ranks mismatched
+    collective shapes) > in-code defaults equal to the round-5 on-chip
+    winner (batch 256 / scan 8 / space-to-depth stem = 32.2% MFU,
+    benchmarks/chip_evidence_r5/) so a fresh container with no tuned
+    file still measures the winner.
+
+    A tuned file WITHOUT an ``s2d`` key keeps the standard stem its own
+    sweep used (pre-r5 files); an explicit opinion (True or False)
+    always wins over the in-code default. quick/CI smoke never applies
+    the stem/lowering defaults, and non-resnet50 models start from
+    conservative defaults because the sweep ran on resnet50.
+
+    Returns ``(batch, scan_steps)`` defaults.
+    """
+    model = _bench_model_name()
+    tuned_batch, tuned_scan = 256, 8
+    tuned_s2d = None       # None = no tuned-file opinion; resolved below
+    tuned_file_read = False
+    if model != "resnet50":
+        # a deeper model at the resnet50-swept batch risks burning a
+        # chip window on an OOM
+        tuned_batch, tuned_scan = 128, 4
+    if single_process and model == "resnet50":
+        try:
+            with open(tuned_path) as f:
+                tuned = json.load(f)
+            tuned_file_read = True
+            tuned_batch = int(tuned.get("batch", tuned_batch))
+            tuned_scan = int(tuned.get("scan_steps", tuned_scan))
+            if "s2d" in tuned:
+                tuned_s2d = bool(tuned["s2d"])
+            if tuned.get("conv_impl") and not quick:
+                # campaign found a different conv lowering faster on
+                # this platform (benchmarks/probe_conv.py)
+                os.environ.setdefault("HVD_BENCH_CONV_IMPL",
+                                      str(tuned["conv_impl"]))
+        except Exception:
+            pass
+    if model == "resnet50" and tuned_s2d is None and not tuned_file_read:
+        # deterministic across ranks, so safe for multi-host runs too
+        tuned_s2d = True
+    if tuned_s2d and not quick:
+        os.environ.setdefault("HVD_BENCH_S2D", "1")
+    return tuned_batch, tuned_scan
 
 
 def _bench_model_name() -> str:

@@ -339,6 +339,64 @@ def test_bench_parent_fallback_emits_parseable_json(monkeypatch, capsys, tmp_pat
     assert (cap.err + cap.out)[-500:].rstrip().endswith(last)
 
 
+def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
+    """Round-5 container-reset lesson (bench._resolve_tuned_config): a
+    wiped gitignored bench_tuned.json must not downgrade the driver's
+    end-of-round run below the measured winner; an explicit campaign
+    opinion (including s2d=false) must win over the in-code default; and
+    a pre-r5 tuned file without the s2d key keeps the standard stem its
+    own sweep measured."""
+    import json as _json
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+
+    def resolve(quick=False, single=True, tuned=None, model=None):
+        for var in ("HVD_BENCH_S2D", "HVD_BENCH_CONV_IMPL",
+                    "HVD_BENCH_MODEL"):
+            monkeypatch.delenv(var, raising=False)
+        if model:
+            monkeypatch.setenv("HVD_BENCH_MODEL", model)
+        path = str(tmp_path / "missing.json")
+        if tuned is not None:
+            path = str(tmp_path / "tuned.json")
+            with open(path, "w") as f:
+                _json.dump(tuned, f)
+        batch, scan = bench._resolve_tuned_config(quick, single,
+                                                  tuned_path=path)
+        return (batch, scan, os.environ.get("HVD_BENCH_S2D"),
+                os.environ.get("HVD_BENCH_CONV_IMPL"))
+
+    try:
+        # fresh container, no tuned file: the on-chip winner incl. stem
+        assert resolve() == (256, 8, "1", None)
+        # multi-host: per-machine file ignored (rank desync risk), but
+        # the deterministic in-code stem default still applies
+        assert resolve(single=False,
+                       tuned={"batch": 4, "scan_steps": 1,
+                              "s2d": False}) == (256, 8, "1", None)
+        # explicit campaign opinion wins, including s2d=false
+        assert resolve(tuned={"batch": 320, "scan_steps": 16,
+                              "s2d": False}) == (320, 16, None, None)
+        # pre-r5 file without the s2d key: its sweep used the standard
+        # stem — don't pair its batch/scan with a stem it never swept
+        assert resolve(tuned={"batch": 512,
+                              "scan_steps": 4}) == (512, 4, None, None)
+        # s2d=true and a conv-lowering opinion ride through
+        assert resolve(tuned={"batch": 256, "scan_steps": 8, "s2d": True,
+                              "conv_impl": "im2col"}) == (256, 8, "1",
+                                                          "im2col")
+        # quick/CI smoke never applies the stem/lowering defaults
+        assert resolve(quick=True) == (256, 8, None, None)
+        # non-resnet50: conservative defaults, no resnet50-swept stem
+        assert resolve(model="resnet101") == (128, 4, None, None)
+    finally:
+        for var in ("HVD_BENCH_S2D", "HVD_BENCH_CONV_IMPL"):
+            os.environ.pop(var, None)
+
+
 def test_bench_model_selection(monkeypatch):
     """HVD_BENCH_MODEL switches the benchmarked model + FLOP constant
     (resnet101 = apples-to-apples with the reference's only published
