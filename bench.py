@@ -364,7 +364,7 @@ def _resolve_tuned_config(quick: bool, single_process: bool,
     > campaign-written ``bench_tuned.json`` (single-process resnet50
     only: per-machine files could hand multi-host ranks mismatched
     collective shapes) > in-code defaults equal to the round-5 on-chip
-    winner (batch 256 / scan 8 / space-to-depth stem = 32.2% MFU,
+    winner (batch 128 / scan 32 / space-to-depth stem = 34.2% MFU,
     benchmarks/chip_evidence_r5/) so a fresh container with no tuned
     file still measures the winner.
 
@@ -377,7 +377,7 @@ def _resolve_tuned_config(quick: bool, single_process: bool,
     Returns ``(batch, scan_steps)`` defaults.
     """
     model = _bench_model_name()
-    tuned_batch, tuned_scan = 256, 8
+    tuned_batch, tuned_scan = 128, 32
     tuned_s2d = None       # None = no tuned-file opinion; resolved below
     tuned_file_read = False
     if model != "resnet50":

@@ -1,10 +1,13 @@
-"""Post-sweep push: configs around the round-5 winner (batch 256 /
-scan 8 / space-to-depth = 32.1% MFU) that the resnet and sweep phases
-did not cover — deeper scan at the winning stem and intermediate
-batches. Each result appends to mfu_results.jsonl; a new winner updates
+"""Post-sweep push: probe the current tuned winner's NEIGHBORHOOD —
+configs the resnet/sweep phases did not cover. The center comes from
+bench_tuned.json at runtime (round-5 second window moved the winner
+from batch 256/scan 8/s2d to batch 128/scan 32/s2d, so a hardcoded
+neighborhood goes stale the moment the sweep learns something). Each
+result appends to mfu_results.jsonl; a new winner updates
 bench_tuned.json so the driver's bench run inherits it.
 """
 
+import json
 import os
 import sys
 
@@ -21,10 +24,35 @@ record = make_recorder(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "mfu_results.jsonl"))
 
 
+def neighborhood(batch, scan, s2d):
+    """Unexplored configs around the winner, most promising first.
+
+    The sweep grid is (128, 256, 512) x (1, 8, 32) on the standard stem
+    plus one s2d trial at its winner, so the open directions are:
+    smaller batches (the 512->256->128 gradient pointed down in the
+    second window), deeper scan, and the flipped stem at the winner.
+    """
+    cand = [
+        (max(batch // 2, 32), scan, s2d),        # continue batch gradient
+        (batch, min(scan * 2, 64), s2d),         # deeper scan at winner
+        (max(batch // 2, 32), min(scan * 2, 64), s2d),
+        (batch, scan, not s2d),                  # flipped stem at winner
+        (max(3 * batch // 4, 32), scan, s2d),    # intermediate batches
+        (3 * batch // 2, scan, s2d),
+    ]
+    seen, out = {(batch, scan, s2d)}, []
+    for c in cand:
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
 def main():
     import horovod_tpu as hvd
     from bench import (RESNET50_FWD_FLOP_PER_IMG as FWD,
-                       TRAIN_FLOP_MULT, bench_resnet, chip_peak_flops)
+                       TRAIN_FLOP_MULT, _TUNED_PATH, bench_resnet,
+                       chip_peak_flops)
     from horovod_tpu.models import ResNet50
 
     enable_compilation_cache()
@@ -32,7 +60,17 @@ def main():
     require_tpu()
     hvd.init()
     PEAK = chip_peak_flops()
-    record(event="push_start", device=jax.devices()[0].device_kind)
+
+    try:
+        with open(_TUNED_PATH) as f:
+            tuned = json.load(f)
+        center = (int(tuned["batch"]), int(tuned["scan_steps"]),
+                  bool(tuned.get("s2d", False)))
+    except Exception:
+        center = (128, 32, True)  # round-5 second-window winner (s2d)
+    record(event="push_start", device=jax.devices()[0].device_kind,
+           center={"batch": center[0], "scan": center[1],
+                   "s2d": center[2]})
 
     def model(s2d):
         return lambda: ResNet50(num_classes=1000, dtype=jnp.bfloat16,
@@ -40,9 +78,7 @@ def main():
 
     best = None
     wedged = False
-    for batch, scan, s2d in ((256, 16, True), (256, 32, True),
-                             (384, 8, True), (320, 16, True),
-                             (512, 16, True)):
+    for batch, scan, s2d in neighborhood(*center):
         try:
             ips = bench_resnet(batch, warmup=2, iters=4, scan_steps=scan,
                                model_fn=model(s2d))

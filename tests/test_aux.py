@@ -371,12 +371,12 @@ def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
 
     try:
         # fresh container, no tuned file: the on-chip winner incl. stem
-        assert resolve() == (256, 8, "1", None)
+        assert resolve() == (128, 32, "1", None)
         # multi-host: per-machine file ignored (rank desync risk), but
         # the deterministic in-code stem default still applies
         assert resolve(single=False,
                        tuned={"batch": 4, "scan_steps": 1,
-                              "s2d": False}) == (256, 8, "1", None)
+                              "s2d": False}) == (128, 32, "1", None)
         # explicit campaign opinion wins, including s2d=false
         assert resolve(tuned={"batch": 320, "scan_steps": 16,
                               "s2d": False}) == (320, 16, None, None)
@@ -389,7 +389,7 @@ def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
                               "conv_impl": "im2col"}) == (256, 8, "1",
                                                           "im2col")
         # quick/CI smoke never applies the stem/lowering defaults
-        assert resolve(quick=True) == (256, 8, None, None)
+        assert resolve(quick=True) == (128, 32, None, None)
         # non-resnet50: conservative defaults, no resnet50-swept stem
         assert resolve(model="resnet101") == (128, 4, None, None)
     finally:
