@@ -381,9 +381,11 @@ def _resolve_tuned_config(quick: bool, single_process: bool,
     tuned_s2d = None       # None = no tuned-file opinion; resolved below
     tuned_file_read = False
     if model != "resnet50":
-        # a deeper model at the resnet50-swept batch risks burning a
-        # chip window on an OOM
-        tuned_batch, tuned_scan = 128, 4
+        # batch stays conservative (a deeper model at the resnet50-swept
+        # batch risks burning a chip window on an OOM); scan 8 is the
+        # r101 banked-artifact config (44.0% MFU, chip_evidence_r5 —
+        # scan 32 measured within noise of it)
+        tuned_batch, tuned_scan = 128, 8
     if single_process and model == "resnet50":
         try:
             with open(tuned_path) as f:

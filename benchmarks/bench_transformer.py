@@ -114,6 +114,12 @@ def main():
     record(event="start", device=jax.devices()[0].device_kind)
     ok = 0
     for kw in (
+            # no-remat is the throughput winner where activations fit
+            # HBM (round-5 probe: 53.9% vs 48.5% MFU at b8/s1024/scan 8,
+            # 54.0% at scan 32 — remat recompute is non-useful work in
+            # the MFU accounting); remat rows below remain the
+            # long-seq/memory story
+            dict(scan_steps=8, remat=False),
             dict(scan_steps=8),
             dict(scan_steps=1),
             dict(seq=2048, batch=4, scan_steps=8),

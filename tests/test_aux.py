@@ -390,8 +390,9 @@ def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
                                                           "im2col")
         # quick/CI smoke never applies the stem/lowering defaults
         assert resolve(quick=True) == (128, 32, None, None)
-        # non-resnet50: conservative defaults, no resnet50-swept stem
-        assert resolve(model="resnet101") == (128, 4, None, None)
+        # non-resnet50: conservative batch, the r101 banked-artifact
+        # scan, and no resnet50-swept stem
+        assert resolve(model="resnet101") == (128, 8, None, None)
     finally:
         for var in ("HVD_BENCH_S2D", "HVD_BENCH_CONV_IMPL"):
             os.environ.pop(var, None)
