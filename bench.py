@@ -22,9 +22,10 @@ completes, so fetching a scalar is the only trustworthy barrier.
 Prints ONE JSON line:
   {"metric": "<model>_images_per_sec_per_chip", "value": N,
    "unit": "images/sec/chip", "vs_baseline": N, "mfu": F, "extras": {...}}
-where <model> is resnet50 (default) or resnet101
-(``HVD_BENCH_MODEL=resnet101`` — apples-to-apples with the reference's
-published ResNet-101 number).
+where <model> is resnet50 (default), resnet101, vgg16, or inception3
+(``HVD_BENCH_MODEL=...``) — the reference's full published benchmark
+suite (docs/benchmarks.rst:11-41); resnet101 is apples-to-apples with
+its only absolute number.
 """
 
 import json
@@ -37,8 +38,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from collections import namedtuple
+
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50, ResNet101
+from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
+from horovod_tpu.models.inception import INCEPTION3_FWD_FLOP_PER_IMG
+from horovod_tpu.models.vgg import VGG16_FWD_FLOP_PER_IMG
 from horovod_tpu.parallel import data_parallel_step
 
 BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
@@ -53,15 +58,30 @@ RESNET50_FWD_FLOP_PER_IMG = 2 * 4.09e9
 RESNET101_FWD_FLOP_PER_IMG = 2 * 7.8e9
 TRAIN_FLOP_MULT = 3.0  # fwd + bwd ≈ 3x fwd
 
-# HVD_BENCH_MODEL picks the benchmarked model. resnet101 exists so the
-# vs_baseline ratio can be apples-to-apples with the reference's ONLY
-# published absolute number (ResNet-101, docs/benchmarks.rst:31-41);
-# resnet50 stays the default (BASELINE.json's driver target).
+# HVD_BENCH_MODEL picks the benchmarked model — the reference's full
+# published benchmark suite (docs/benchmarks.rst:11-41: ResNet-101,
+# Inception V3, VGG-16) plus resnet50 (BASELINE.json's driver target,
+# the default). resnet101 is the apples-to-apples row for the
+# reference's ONLY absolute number. resnet_knobs marks models that
+# accept the space_to_depth/conv_impl stem options (swept on resnet50).
+# default_batch/scan are the no-tuned-file starting points: conservative
+# for the models never batch-swept on chip (an OOM burns a window).
+_BenchModel = namedtuple(
+    "_BenchModel",
+    "metric fwd_flop cls image_size resnet_knobs default_batch default_scan")
 _BENCH_MODELS = {
-    "resnet50": ("resnet50_images_per_sec_per_chip",
-                 RESNET50_FWD_FLOP_PER_IMG, ResNet50),
-    "resnet101": ("resnet101_images_per_sec_per_chip",
-                  RESNET101_FWD_FLOP_PER_IMG, ResNet101),
+    "resnet50": _BenchModel("resnet50_images_per_sec_per_chip",
+                            RESNET50_FWD_FLOP_PER_IMG, ResNet50, 224,
+                            True, 128, 32),
+    "resnet101": _BenchModel("resnet101_images_per_sec_per_chip",
+                             RESNET101_FWD_FLOP_PER_IMG, ResNet101, 224,
+                             True, 128, 8),
+    "vgg16": _BenchModel("vgg16_images_per_sec_per_chip",
+                         VGG16_FWD_FLOP_PER_IMG, VGG16, 224,
+                         False, 64, 8),
+    "inception3": _BenchModel("inception3_images_per_sec_per_chip",
+                              INCEPTION3_FWD_FLOP_PER_IMG, InceptionV3, 299,
+                              False, 64, 8),
 }
 
 # bf16 peak FLOP/s by device kind (first matching substring wins)
@@ -110,9 +130,11 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
     conv_impl = _env_conv_impl()
 
     def default_model():
-        cls = _BENCH_MODELS[_bench_model_name()][2]
-        return cls(num_classes=num_classes, dtype=jnp.bfloat16,
-                   space_to_depth=s2d, conv_impl=conv_impl)
+        spec = _BENCH_MODELS[_bench_model_name()]
+        if spec.resnet_knobs:
+            return spec.cls(num_classes=num_classes, dtype=jnp.bfloat16,
+                            space_to_depth=s2d, conv_impl=conv_impl)
+        return spec.cls(num_classes=num_classes, dtype=jnp.bfloat16)
 
     model = (model_fn or default_model)()
     rng = jax.random.PRNGKey(0)
@@ -123,45 +145,61 @@ def bench_resnet(per_chip_batch: int, warmup: int = 5, iters: int = 30,
     labels = jnp.asarray(
         np.random.RandomState(1).randint(0, num_classes, (batch,)))
 
-    variables = model.init(rng, images[:2], train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    # "dropout" rng: consumed by dropout-bearing models (VGG); flax
+    # ignores unused rng streams for the others. BN-less models (VGG
+    # again) have no batch_stats collection — carry an empty dict and
+    # skip the mutable round trip.
+    variables = model.init({"params": rng, "dropout": jax.random.PRNGKey(1)},
+                           images[:2], train=True)
+    params = variables["params"]
+    has_bn = "batch_stats" in variables
+    batch_stats = variables["batch_stats"] if has_bn else {}
     opt = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
     opt_state = opt.init(params)
     params = hvd.broadcast_parameters(params, root_rank=0)
 
-    def one_step(params, batch_stats, opt_state, images, labels):
+    def one_step(params, batch_stats, opt_state, step_rng, images, labels):
+        # fresh dropout mask each sub-step, so scan cannot hoist the
+        # mask generation out of the measured loop
+        step_rng, drop = jax.random.split(step_rng)
+
         def loss_fn(p):
+            vs = {"params": p}
+            if has_bn:
+                vs["batch_stats"] = batch_stats
             logits, upd = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images, train=True,
-                mutable=["batch_stats"])
+                vs, images, train=True,
+                mutable=["batch_stats"] if has_bn else [],
+                rngs={"dropout": drop})
             onehot = jax.nn.one_hot(labels, num_classes)
             loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
-            return loss, upd["batch_stats"]
+            return loss, (upd["batch_stats"] if has_bn else batch_stats)
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_stats, opt_state, loss
+        return params, new_stats, opt_state, step_rng, loss
 
     def step(train_state, opt_state, images, labels):
-        params, batch_stats = train_state
+        params, batch_stats, step_rng = train_state
         if scan_steps <= 1:
-            params, batch_stats, opt_state, loss = one_step(
-                params, batch_stats, opt_state, images, labels)
+            params, batch_stats, opt_state, step_rng, loss = one_step(
+                params, batch_stats, opt_state, step_rng, images, labels)
         else:
             def body(carry, _):
-                p, b, s = carry
-                p, b, s, loss = one_step(p, b, s, images, labels)
-                return (p, b, s), loss
+                p, b, s, r = carry
+                p, b, s, r, loss = one_step(p, b, s, r, images, labels)
+                return (p, b, s, r), loss
 
-            (params, batch_stats, opt_state), losses = jax.lax.scan(
-                body, (params, batch_stats, opt_state), None,
+            (params, batch_stats, opt_state, step_rng), losses = jax.lax.scan(
+                body, (params, batch_stats, opt_state, step_rng), None,
                 length=scan_steps)
             loss = losses[-1]
-        return (params, batch_stats), opt_state, jax.lax.pmean(loss, "hvd")
+        return ((params, batch_stats, step_rng), opt_state,
+                jax.lax.pmean(loss, "hvd"))
 
     compiled = data_parallel_step(step, batch_argnums=(2, 3))
-    state = (params, batch_stats)
+    state = (params, batch_stats, jax.random.PRNGKey(2))
     for _ in range(warmup):
         state, opt_state, loss = compiled(state, opt_state, images, labels)
     _sync(loss)
@@ -284,10 +322,12 @@ def main():
     per_chip = _sync_int_env("HVD_BENCH_BATCH", 32 if quick else tuned_batch)
     scan_steps = _sync_int_env("HVD_BENCH_SCAN_STEPS",
                                1 if quick else tuned_scan)
+    spec = _BENCH_MODELS[_bench_model_name()]
     per_chip_ips = bench_resnet(per_chip, warmup=2 if quick else 5,
                                 iters=3 if quick else 8,
-                                scan_steps=scan_steps)
-    metric_name, fwd_flop, _ = _BENCH_MODELS[_bench_model_name()]
+                                scan_steps=scan_steps,
+                                image_size=spec.image_size)
+    metric_name, fwd_flop = spec.metric, spec.fwd_flop
     flops = per_chip_ips * fwd_flop * TRAIN_FLOP_MULT
     mfu = flops / chip_peak_flops()
     def safe(fn, *args, **kw):
@@ -313,8 +353,10 @@ def main():
                                 128 if quick else 512),
         "per_chip_batch": per_chip,
         "scan_steps": scan_steps,
-        "s2d": _env_s2d(),
-        "conv_impl": _env_conv_impl(),
+        # null for models whose builder ignores the resnet stem knobs —
+        # the artifact must not claim a stem the model never used
+        "s2d": _env_s2d() if spec.resnet_knobs else None,
+        "conv_impl": _env_conv_impl() if spec.resnet_knobs else None,
         "device": jax.devices()[0].device_kind,
         # r5: constants corrected to 2 FLOPs/MAC (rounds 1-4 understated
         # mfu ~2x; round-1's 2241 img/s was ~0.28 mfu in this convention)
@@ -377,15 +419,15 @@ def _resolve_tuned_config(quick: bool, single_process: bool,
     Returns ``(batch, scan_steps)`` defaults.
     """
     model = _bench_model_name()
-    tuned_batch, tuned_scan = 128, 32
+    # per-model starting points (_BENCH_MODELS): resnet50 = the swept
+    # on-chip winner; resnet101 = its banked-artifact config (44.0% MFU,
+    # chip_evidence_r5 — scan 32 measured within noise); vgg16 and
+    # inception3 = conservative batches, never batch-swept on chip (an
+    # OOM burns a window)
+    spec = _BENCH_MODELS[model]
+    tuned_batch, tuned_scan = spec.default_batch, spec.default_scan
     tuned_s2d = None       # None = no tuned-file opinion; resolved below
     tuned_file_read = False
-    if model != "resnet50":
-        # batch stays conservative (a deeper model at the resnet50-swept
-        # batch risks burning a chip window on an OOM); scan 8 is the
-        # r101 banked-artifact config (44.0% MFU, chip_evidence_r5 —
-        # scan 32 measured within noise of it)
-        tuned_batch, tuned_scan = 128, 8
     if single_process and model == "resnet50":
         try:
             with open(tuned_path) as f:
@@ -539,7 +581,7 @@ def _parent_main() -> int:
         fb_err = "TPU and CPU fallback both timed out"
     # last resort: one well-formed JSON artifact, whatever happened
     try:
-        metric = _BENCH_MODELS[_bench_model_name()][0]
+        metric = _BENCH_MODELS[_bench_model_name()].metric
     except SystemExit:
         metric = "resnet50_images_per_sec_per_chip"
     line = json.dumps({

@@ -1,0 +1,36 @@
+#!/bin/bash
+# One-shot retry for the inception3 bench leg after a tunnel wedge
+# (round-5: the first attempt's child hit its 2400 s timeout mid-window
+# when the tunnel dropped ~11:40). Probes every 2 min; on recovery runs
+# the inception3 leg, then re-runs the default resnet50 leg so
+# bench_result.json ends the session holding the flagship artifact.
+cd "$(dirname "$0")/.." || exit 1
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+DEADLINE=$(( $(date +%s) + ${1:-7} * 3600 ))
+LOG=benchmarks/inception_retry.log
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert 'tpu' in (d.platform + ' ' + d.device_kind).lower(), d
+float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
+    echo "TUNNEL-UP $(date +%H:%M:%S)" | tee -a "$LOG"
+    if HVD_BENCH_MODEL=inception3 timeout 3600 python bench.py \
+        > benchmarks/.inc_r5.tmp 2>>"$LOG" \
+        && grep -q '"metric"' benchmarks/.inc_r5.tmp \
+        && ! grep -q fallback benchmarks/.inc_r5.tmp; then
+      mv benchmarks/.inc_r5.tmp benchmarks/bench_r5_inception3.json
+      echo "INCEPTION-BANKED $(date +%H:%M:%S)" | tee -a "$LOG"
+      timeout 3000 python bench.py >> "$LOG" 2>&1
+      echo "FLAGSHIP-RERUN-DONE $(date +%H:%M:%S)" | tee -a "$LOG"
+      exit 0
+    fi
+    echo "attempt failed $(date +%H:%M:%S)" >> "$LOG"
+  else
+    echo "probe down $(date +%H:%M:%S)" >> "$LOG"
+  fi
+  sleep 120
+done
+echo "RETRY-EXPIRED $(date +%H:%M:%S)" | tee -a "$LOG"

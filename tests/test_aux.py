@@ -339,6 +339,24 @@ def test_bench_parent_fallback_emits_parseable_json(monkeypatch, capsys, tmp_pat
     assert (cap.err + cap.out)[-500:].rstrip().endswith(last)
 
 
+def test_bench_resnet_runs_bnless_dropout_model():
+    """bench_resnet's no-batch-stats path (VGG: dropout-rng threading
+    through the scan carry, mutable=[] apply) must EXECUTE in CI — a
+    regression there would otherwise only surface by burning a chip
+    window on an HVD_BENCH_MODEL=vgg16 run."""
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+    from horovod_tpu.models import VGG
+
+    tiny = lambda: VGG(stages=((1, 8), (1, 8)), num_classes=10,
+                       dtype=jnp.float32)
+    ips = bench.bench_resnet(2, warmup=1, iters=1, scan_steps=2,
+                             image_size=32, num_classes=10, model_fn=tiny)
+    assert ips > 0
+
+
 def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
     """Round-5 container-reset lesson (bench._resolve_tuned_config): a
     wiped gitignored bench_tuned.json must not downgrade the driver's
@@ -412,15 +430,25 @@ def test_bench_model_selection(monkeypatch):
 
     monkeypatch.setenv("HVD_BENCH_MODEL", "resnet101")
     assert bench._bench_model_name() == "resnet101"
-    metric, flop, cls = bench._BENCH_MODELS["resnet101"]
-    assert metric == "resnet101_images_per_sec_per_chip"
-    assert flop > bench.RESNET50_FWD_FLOP_PER_IMG
-    assert cls is models.ResNet101
-    m = cls(num_classes=10, dtype=jnp.bfloat16,
-            space_to_depth=False, conv_impl="native")
+    spec = bench._BENCH_MODELS["resnet101"]
+    assert spec.metric == "resnet101_images_per_sec_per_chip"
+    assert spec.fwd_flop > bench.RESNET50_FWD_FLOP_PER_IMG
+    assert spec.cls is models.ResNet101
+    m = spec.cls(num_classes=10, dtype=jnp.bfloat16,
+                 space_to_depth=False, conv_impl="native")
     assert list(m.stage_sizes) == [3, 4, 23, 3]
 
-    monkeypatch.setenv("HVD_BENCH_MODEL", "vgg16")
+    # the reference's full benchmark suite (docs/benchmarks.rst:11-41):
+    # VGG-16 and Inception V3 are selectable too, without the
+    # resnet-only stem knobs and at their canonical input sizes
+    vgg = bench._BENCH_MODELS["vgg16"]
+    assert (vgg.cls, vgg.image_size, vgg.resnet_knobs) == (
+        models.VGG16, 224, False)
+    inc = bench._BENCH_MODELS["inception3"]
+    assert (inc.cls, inc.image_size, inc.resnet_knobs) == (
+        models.InceptionV3, 299, False)
+
+    monkeypatch.setenv("HVD_BENCH_MODEL", "alexnet")
     with pytest.raises(SystemExit, match="HVD_BENCH_MODEL"):
         bench._bench_model_name()
     monkeypatch.delenv("HVD_BENCH_MODEL")
