@@ -408,9 +408,11 @@ def test_bench_tuned_config_resolution(monkeypatch, tmp_path):
                                                           "im2col")
         # quick/CI smoke never applies the stem/lowering defaults
         assert resolve(quick=True) == (128, 32, None, None)
-        # non-resnet50: conservative batch, the r101 banked-artifact
-        # scan, and no resnet50-swept stem
+        # non-resnet50: per-model conservative defaults, and never the
+        # resnet50-swept stem
         assert resolve(model="resnet101") == (128, 8, None, None)
+        assert resolve(model="vgg16") == (64, 8, None, None)
+        assert resolve(model="inception3") == (64, 8, None, None)
     finally:
         for var in ("HVD_BENCH_S2D", "HVD_BENCH_CONV_IMPL"):
             os.environ.pop(var, None)
