@@ -19,6 +19,36 @@ HOURS="${1:-10}"
 DEADLINE=$(( $(date +%s) + HOURS * 3600 ))
 LOG=benchmarks/watch.log
 
+# Container resets wipe benchmarks/markers/ and bench_tuned.json
+# (gitignored per-machine state) while the banked evidence survives in
+# git (chip_evidence_r5/). Bootstrap markers from committed evidence so
+# a fresh container's campaign re-measures only what never banked
+# (r5 second window: the un-bootstrapped campaign would have re-burned
+# ~25 min of a scarce uptime window). HVD_CAMPAIGN_REMEASURE=1 forces
+# a full re-run (clears existing markers too).
+if [ "${HVD_CAMPAIGN_REMEASURE:-0}" = "1" ]; then
+  rm -f benchmarks/markers/*.done
+else
+  ev=benchmarks/chip_evidence_r5
+  [ -f "$ev/mfu_results_r5.jsonl" ]       && touch benchmarks/markers/resnet.done
+  [ -f "$ev/eager_chip.jsonl" ]           && touch benchmarks/markers/eager.done
+  [ -f "$ev/timeline_chip.json" ]         && touch benchmarks/markers/timeline.done
+  [ -f "$ev/probe_conv.jsonl" ]           && touch benchmarks/markers/probe.done
+  [ -f "$ev/transformer_mfu.jsonl" ]      && touch benchmarks/markers/transformer.done
+  [ -f "$ev/bench_r5_chip.json" ]         && touch benchmarks/markers/bench.done
+  [ -f "$ev/bench_r5_resnet101.json" ]    && touch benchmarks/markers/r101.done
+  [ -f "$ev/torch_shim_chip.jsonl" ]      && touch benchmarks/markers/torchshim.done
+  [ -f "$ev/memory_analysis_chip.jsonl" ] && touch benchmarks/markers/memory.done
+  [ -f "$ev/mfu_results_r5_w2.jsonl" ]    && touch benchmarks/markers/sweep.done \
+                                          && touch benchmarks/markers/push.done
+  [ -f "$ev/bench_r5_inception3.json" ]   && touch benchmarks/markers/inception.done
+  # the measured winner, so sweep/push comparisons and bench.py start
+  # from it (bench.py's in-code defaults already match — belt+braces)
+  [ -f benchmarks/bench_tuned.json ] || printf '%s' \
+    '{"batch": 128, "scan_steps": 32, "conv_impl": "native", "s2d": true, "img_s": 2757.1}' \
+    > benchmarks/bench_tuned.json
+fi
+
 phase() {  # phase <name> <timeout_s> <cmd...>
   local name="$1" tmo="$2"; shift 2
   [ -f "benchmarks/markers/$name.done" ] && return 0
@@ -35,10 +65,21 @@ phase() {  # phase <name> <timeout_s> <cmd...>
 }
 
 all_done() {
-  for m in resnet eager timeline probe transformer sweep bench r101 torchshim memory push; do
+  for m in resnet eager timeline probe transformer sweep bench r101 torchshim memory push inception; do
     [ -f "benchmarks/markers/$m.done" ] || return 1
   done
   return 0
+}
+
+bench_artifact_phase() {
+  # bench_artifact_phase <name> <outer_tmo> <artifact> <grep_token> [env prefix]
+  # One shared tee/validate/mv pipeline for every bench.py artifact leg
+  # (bench, r101, inception): a fallback or truncated run never replaces
+  # the artifact, and each leg gets its own tmp file so concurrent
+  # harnesses can't interleave writes.
+  local name="$1" tmo="$2" artifact="$3" token="$4" envp="${5:-}"
+  local tmp="benchmarks/.${name}_r5.tmp"
+  phase "$name" "$tmo" bash -c "set -o pipefail; $envp python bench.py | tee $tmp && grep -q '$token' $tmp && ! grep -q fallback $tmp && mv $tmp $artifact"
 }
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
@@ -70,10 +111,14 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     # sweep/push can still raise the tuned config afterwards, and the
     # driver's own end-of-round bench run inherits that improvement.
     phase transformer 2700 python benchmarks/bench_transformer.py && \
-    phase bench      5400  bash -c 'set -o pipefail; python bench.py | tee benchmarks/.bench_r5_chip.tmp && grep -q "\"metric\"" benchmarks/.bench_r5_chip.tmp && ! grep -q fallback benchmarks/.bench_r5_chip.tmp && mv benchmarks/.bench_r5_chip.tmp benchmarks/bench_r5_chip.json' && \
-    phase r101       5400  bash -c 'set -o pipefail; HVD_BENCH_MODEL=resnet101 HVD_BENCH_SCAN_STEPS=8 python bench.py | tee benchmarks/.bench_r5_r101.tmp && grep -q resnet101 benchmarks/.bench_r5_r101.tmp && ! grep -q fallback benchmarks/.bench_r5_r101.tmp && mv benchmarks/.bench_r5_r101.tmp benchmarks/bench_r5_resnet101.json' && \
+    bench_artifact_phase bench 5400 benchmarks/bench_r5_chip.json '"metric"' && \
+    bench_artifact_phase r101  5400 benchmarks/bench_r5_resnet101.json resnet101 'HVD_BENCH_MODEL=resnet101 HVD_BENCH_SCAN_STEPS=8' && \
     phase torchshim   900  python benchmarks/torch_shim_phase.py && \
     phase memory     1800  python benchmarks/memory_analysis.py --big && \
+    # inception3 completes the reference's published benchmark suite;
+    # compile-heavy (many distinct conv shapes), so the child cap is
+    # raised and the outer budget contains probe+child+fallback
+    bench_artifact_phase inception 6000 benchmarks/bench_r5_inception3.json inception3 'HVD_BENCH_MODEL=inception3 HVD_BENCH_CHILD_TIMEOUT=3300' && \
     phase sweep      3600  python benchmarks/mfu_campaign.py     && \
     phase push       2700  python benchmarks/push_phase.py
   else
