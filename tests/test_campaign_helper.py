@@ -1,0 +1,109 @@
+"""The recovery campaign's artifact pipeline must never bank a fallback
+or truncated bench run (benchmarks/recovery_campaign.sh:
+bench_artifact_phase), and a container reset must bootstrap phase
+markers from committed evidence — the two behaviors that protect scarce
+tunnel windows (round-5 post-mortems in docs/benchmarks.md)."""
+
+import json
+import os
+import subprocess
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "recovery_campaign.sh")
+
+
+def _extract_function(name: str) -> str:
+    """Pull one shell function's source out of the campaign script so
+    the test exercises the SHIPPED definition, not a copy."""
+    src = open(SCRIPT).read()
+    # anchor on line start: "phase()" is a substring of
+    # "bench_artifact_phase()", so a bare index() would depend on
+    # definition order
+    start = src.index(f"\n{name}()") + 1
+    # functions in this script close with a line containing only '}'
+    end = src.index("\n}\n", start) + 3
+    return src[start:end]
+
+
+def _run_shell(body: str, cwd: str) -> subprocess.CompletedProcess:
+    script = (
+        "set -u\nLOG=watch.log\n"
+        + _extract_function("phase")
+        + "\n"
+        + _extract_function("bench_artifact_phase")
+        + "\n"
+        + body
+    )
+    return subprocess.run(["bash", "-c", script], cwd=cwd,
+                          capture_output=True, text=True, timeout=60)
+
+
+def _fake_bench(tmp_path, fallback: bool):
+    (tmp_path / "bench.py").write_text(textwrap.dedent(f"""
+        import json, os
+        model = os.environ.get("HVD_BENCH_MODEL", "resnet50")
+        extras = {{"fallback_cpu": True}} if {fallback!r} else {{}}
+        print(json.dumps({{"metric": model + "_images_per_sec_per_chip",
+                           "value": 1.0, "extras": extras}}))
+        """))
+    (tmp_path / "benchmarks" / "markers").mkdir(parents=True)
+
+
+def test_artifact_phase_banks_good_run_with_env_prefix(tmp_path):
+    _fake_bench(tmp_path, fallback=False)
+    p = _run_shell(
+        "bench_artifact_phase r101 30 out.json resnet101 "
+        "'HVD_BENCH_MODEL=resnet101'",
+        str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    out = json.load(open(tmp_path / "out.json"))
+    assert out["metric"].startswith("resnet101")
+    assert os.path.exists(tmp_path / "benchmarks" / "markers" / "r101.done")
+
+
+def test_artifact_phase_rejects_fallback_run(tmp_path):
+    _fake_bench(tmp_path, fallback=True)
+    p = _run_shell(
+        "bench_artifact_phase bench 30 out.json '\"metric\"'",
+        str(tmp_path))
+    assert p.returncode != 0
+    assert not os.path.exists(tmp_path / "out.json")
+    assert not os.path.exists(
+        tmp_path / "benchmarks" / "markers" / "bench.done")
+    # the rejected output stays in the per-leg tmp file for post-mortem
+    assert os.path.exists(tmp_path / "benchmarks" / ".bench_r5.tmp")
+
+
+def test_artifact_phase_rejects_truncated_run(tmp_path):
+    """A run that dies before printing the expected metric token (wedge
+    mid-stream, wrong model, empty output) must not bank either."""
+    (tmp_path / "bench.py").write_text(
+        "print('partial output, no json line')\n")
+    (tmp_path / "benchmarks" / "markers").mkdir(parents=True)
+    p = _run_shell(
+        "bench_artifact_phase bench 30 out.json '\"metric\"'",
+        str(tmp_path))
+    assert p.returncode != 0
+    assert not os.path.exists(tmp_path / "out.json")
+    assert not os.path.exists(
+        tmp_path / "benchmarks" / "markers" / "bench.done")
+
+
+def test_marker_bootstrap_matches_committed_evidence():
+    """Every evidence file referenced by the bootstrap block exists in
+    the committed chip_evidence_r5 dir (a renamed artifact would
+    silently stop bootstrapping its marker and re-burn a window)."""
+    src = open(SCRIPT).read()
+    block = src[src.index("ev=benchmarks/chip_evidence_r5"):
+                src.index("bench_tuned.json ] ||")]
+    referenced = set()
+    for line in block.splitlines():
+        if '"$ev/' in line:
+            referenced.add(line.split('"$ev/')[1].split('"')[0])
+    assert referenced, "bootstrap block parsed empty"
+    evdir = os.path.join(REPO, "benchmarks", "chip_evidence_r5")
+    missing = [f for f in sorted(referenced)
+               if f != "bench_r5_inception3.json"  # banks when tunnel allows
+               and not os.path.exists(os.path.join(evdir, f))]
+    assert not missing, f"bootstrap references uncommitted evidence: {missing}"
