@@ -20,9 +20,9 @@ float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     # outer budget must contain the whole chain: 120 s probe + 3300 s
     # TPU child + 2400 s CPU fallback + margin, else a TPU-child
     # timeout leaves bench.py SIGTERMed mid-fallback with an orphaned
-    # child still running
+    # child still running (120 + 3300 + 2400 = 5820, so >= 6300)
     if HVD_BENCH_MODEL=inception3 HVD_BENCH_CHILD_TIMEOUT=3300 \
-        timeout 6000 python bench.py \
+        timeout 6300 python bench.py \
         > benchmarks/.inc_r5.tmp 2>>"$LOG" \
         && grep -q '"metric"' benchmarks/.inc_r5.tmp \
         && ! grep -q fallback benchmarks/.inc_r5.tmp; then

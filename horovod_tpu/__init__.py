@@ -90,6 +90,16 @@ from .ops.queue import TensorEntry
 __version__ = "0.1.0"
 
 
+def metrics_snapshot() -> dict:
+    """Structured snapshot of the process-global metrics registry
+    (counters / gauges / histograms as JSON-able dicts) — the Python-side
+    view of what ``GET /metrics`` on the rendezvous server exposes. Valid
+    before init and after shutdown; the registry is process-lifetime."""
+    from .utils import metrics as _metrics
+
+    return _metrics.get_registry().snapshot()
+
+
 # ---------------------------------------------------------------------------
 # Async handle-based API (reference torch/mpi_ops.py:843-879: *_async, poll,
 # synchronize, wait_and_clear)

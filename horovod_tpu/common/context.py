@@ -157,6 +157,7 @@ class _Context:
         self.timeline = None  # utils.timeline.Timeline
         self.stall_inspector = None
         self.autotuner = None
+        self.metrics_dumper = None  # utils.metrics.MetricsDumper
         self.joined = False  # reference global_state.h:107-111
 
 
@@ -303,8 +304,39 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 _ctx.runtime.autotuner = _ctx.autotuner
                 _ctx.runtime.autotune_steps_per_sample = (
                     _ctx.config.autotune_steps_per_sample)
+        _start_metrics_dumper()
         _ctx.initialized = True
         LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
+
+
+def _start_metrics_dumper():
+    """Start the metrics publisher when there is somewhere to publish:
+    a ``HOROVOD_METRICS_FILE`` path and/or (in a launched job) the
+    launcher's KV store, where pushed snapshots feed its ``GET /metrics``.
+    With neither, no thread is created at all — standalone single-process
+    use pays nothing for the subsystem."""
+    from ..utils import metrics as metrics_mod
+
+    crank = _ctx.global_set.cross_rank
+    path = _ctx.config.metrics_file
+    if path and crank != 0:
+        # every rank's dump is a distinct post-mortem artifact; same-host
+        # ranks share the env value, so suffix to avoid clobbering
+        path = f"{path}.rank{crank}"
+    kv = None
+    addr = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+    port = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT)
+    if _ctx.config.metrics_push and addr and port:
+        from ..runner.http_server import KVStoreClient
+
+        kv = KVStoreClient(addr, int(port))
+    if not path and kv is None:
+        return
+    _ctx.metrics_dumper = metrics_mod.MetricsDumper(
+        metrics_mod.get_registry(), file_path=path,
+        interval_s=_ctx.config.metrics_dump_interval_s,
+        kv_client=kv, rank=crank)
+    _ctx.metrics_dumper.start()
 
 
 def shutdown(drain: bool = True):
@@ -325,6 +357,11 @@ def shutdown(drain: bool = True):
         if _ctx.timeline is not None:
             _ctx.timeline.close()
             _ctx.timeline = None
+        if _ctx.metrics_dumper is not None:
+            # stop() performs a final flush: the metrics file / KV push
+            # reflects everything the drained runtime counted
+            _ctx.metrics_dumper.stop()
+            _ctx.metrics_dumper = None
         _ctx.stall_inspector = None
         _ctx.autotuner = None
         _ctx.global_set = None

@@ -37,6 +37,11 @@ HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
 HOROVOD_NUM_NCCL_STREAMS = "HOROVOD_NUM_NCCL_STREAMS"  # accepted, ignored (no NCCL on TPU)
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+# metrics registry exposure (utils/metrics.py): periodic JSON dump path,
+# dump/push interval in seconds, and the worker->launcher KV push toggle
+HOROVOD_METRICS_FILE = "HOROVOD_METRICS_FILE"
+HOROVOD_METRICS_DUMP_INTERVAL = "HOROVOD_METRICS_DUMP_INTERVAL"
+HOROVOD_METRICS_PUSH = "HOROVOD_METRICS_PUSH"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -123,6 +128,9 @@ class RuntimeConfig:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     elastic: bool = False
+    metrics_file: str = ""
+    metrics_dump_interval_s: float = 30.0
+    metrics_push: bool = True
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -152,4 +160,8 @@ class RuntimeConfig:
         c.hierarchical_allreduce = get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE)
         c.hierarchical_allgather = get_bool(HOROVOD_HIERARCHICAL_ALLGATHER)
         c.elastic = get_bool(HOROVOD_ELASTIC)
+        c.metrics_file = get_str(HOROVOD_METRICS_FILE)
+        c.metrics_dump_interval_s = get_float(HOROVOD_METRICS_DUMP_INTERVAL,
+                                              c.metrics_dump_interval_s)
+        c.metrics_push = get_bool(HOROVOD_METRICS_PUSH, True)
         return c

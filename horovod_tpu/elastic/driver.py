@@ -32,6 +32,7 @@ from typing import Callable, Optional
 
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from ..runner.http_server import RendezvousServer
+from ..utils import metrics as metrics_mod
 from .discovery import HostDiscoveryScript, HostManager
 from .registration import FAILURE, SUCCESS, WorkerStateRegistry
 
@@ -91,9 +92,27 @@ class ElasticDriver:
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
         self._prev_host_order: list[str] = []
+        self._prev_slot_ranks: set[int] = set()
         self._epoch = 0
         self._resets = 0
         self._stop = threading.Event()
+        reg = metrics_mod.get_registry()
+        self._m_rank_added = reg.counter(
+            "hvd_elastic_ranks_added_total",
+            "worker ranks added across elastic rounds")
+        self._m_rank_removed = reg.counter(
+            "hvd_elastic_ranks_removed_total",
+            "worker ranks removed across elastic rounds")
+        self._m_resets = reg.counter(
+            "hvd_elastic_resets_total",
+            "elastic resets (membership change or worker failure)")
+        self._m_failures = reg.counter(
+            "hvd_elastic_worker_failures_total",
+            "worker processes that exited nonzero")
+        self._m_epoch = reg.gauge("hvd_elastic_epoch",
+                                  "current elastic incarnation")
+        self._m_world = reg.gauge("hvd_elastic_world_size",
+                                  "slots assigned in the current round")
 
     # -- assignments ---------------------------------------------------------
     def compute_assignments(self) -> list[SlotInfo]:
@@ -115,6 +134,11 @@ class ElasticDriver:
                 f"available slots {np_avail} < min_np {self.min_np}")
         slots = get_host_assignments([HostInfo(h, hosts[h]) for h in order], np)
         self._prev_host_order = order
+        ranks = {s.rank for s in slots}
+        self._m_rank_added.inc(len(ranks - self._prev_slot_ranks))
+        self._m_rank_removed.inc(len(self._prev_slot_ranks - ranks))
+        self._prev_slot_ranks = ranks
+        self._m_world.set(len(slots))
         return slots
 
     # -- epoch / notification ------------------------------------------------
@@ -126,6 +150,7 @@ class ElasticDriver:
 
     def bump_epoch(self):
         self._epoch += 1
+        self._m_epoch.set(self._epoch)
         self.publish_epoch()
 
     # -- main loop -----------------------------------------------------------
@@ -174,6 +199,7 @@ class ElasticDriver:
                 if self.host_manager.update_available_hosts():
                     LOG.info("elastic: host membership changed; resetting")
                     self._resets += 1
+                    self._m_resets.inc()
                     self.bump_epoch()
                     self._terminate(alive)
                     return None
@@ -194,8 +220,10 @@ class ElasticDriver:
             if failed_host:
                 LOG.warning("elastic: worker failed on %s; blacklisting",
                             failed_host)
+                self._m_failures.inc()
                 self.host_manager.blacklist(failed_host)
                 self._resets += 1
+                self._m_resets.inc()
                 self.bump_epoch()
                 self._terminate(alive)
                 if self.host_manager.available_slots() >= self.min_np:

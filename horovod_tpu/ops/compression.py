@@ -11,7 +11,37 @@ kept for API parity.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+from ..utils import metrics as metrics_mod
+
+_m_pre = None
+_m_post = None
+
+
+def _record_wire_bytes(pre, post):
+    """Pre/post-compression byte counters — concrete (eager) values only.
+
+    ``compress`` also runs under jit tracing (opt/_tree_allreduce), where a
+    count would fire once per *trace*, not per step; tracers are skipped so
+    the counters stay truthful for the eager wire path they describe."""
+    if isinstance(pre, jax.core.Tracer) or isinstance(post, jax.core.Tracer):
+        return
+    global _m_pre, _m_post
+    if _m_pre is None:
+        reg = metrics_mod.get_registry()
+        _m_pre = reg.counter("hvd_compression_bytes_total",
+                             "payload bytes around compression",
+                             stage="pre")
+        _m_post = reg.counter("hvd_compression_bytes_total",
+                              "payload bytes around compression",
+                              stage="post")
+    try:
+        _m_pre.inc(int(pre.nbytes))
+        _m_post.inc(int(post.nbytes))
+    except (AttributeError, TypeError):
+        pass  # duck-typed tensors without nbytes: nothing to count
 
 
 class Compressor:
@@ -43,7 +73,9 @@ class _CastCompressor(Compressor):
     def compress(cls, tensor):
         dtype = tensor.dtype
         if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
-            return tensor.astype(cls.wire_dtype), dtype
+            wire = tensor.astype(cls.wire_dtype)
+            _record_wire_bytes(tensor, wire)
+            return wire, dtype
         return tensor, None
 
     @classmethod

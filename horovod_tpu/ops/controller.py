@@ -55,6 +55,8 @@ import os
 import threading
 from typing import Optional
 
+from ..utils import metrics as metrics_mod
+
 LOG = logging.getLogger("horovod_tpu")
 
 
@@ -160,6 +162,18 @@ class KVController:
         # for "negotiation cost is O(1) in steady state")
         self.bytes_sent = 0
         self.fast_rounds = 0
+        reg = metrics_mod.get_registry()
+        # cache hit = SAME_AS_LAST marker round (the response-cache role);
+        # miss = a full re-serialized payload
+        self._m_cache_hit = reg.counter(
+            "hvd_controller_cache_hits_total",
+            "negotiation rounds sent as the 1-byte SAME_AS_LAST marker")
+        self._m_cache_miss = reg.counter(
+            "hvd_controller_cache_misses_total",
+            "negotiation rounds sent as a full payload")
+        self._m_wire_bytes = reg.counter(
+            "hvd_controller_wire_bytes_total",
+            "negotiation submission bytes put to the KV store")
         self._coord: Optional[_Coordinator] = None
         if rank == 0:
             self._coord = _Coordinator(client, size,
@@ -191,10 +205,13 @@ class KVController:
             if payload == self._last_payload:
                 wire = self.SAME_AS_LAST
                 self.fast_rounds += 1
+                self._m_cache_hit.inc()
             else:
                 wire = payload
+                self._m_cache_miss.inc()
             self.client.put(_ctl_scope(r), f"ready/{self.rank}", wire)
             self.bytes_sent += len(wire)
+            self._m_wire_bytes.inc(len(wire))
             self._last_payload = payload
             resp = json.loads(self.client.get(_ctl_scope(r), "resp",
                                               timeout=self.poll_timeout))
@@ -301,6 +318,19 @@ class _Coordinator(threading.Thread):
         self._first_seen: dict[str, float] = {}
         self._stall_warned: set[str] = set()
         self.stall_warnings = 0  # observability for tests
+        reg = metrics_mod.get_registry()
+        self._m_responses = reg.counter(
+            "hvd_coordinator_responses_total",
+            "negotiation responses published by the rank-0 coordinator")
+        self._m_ready = reg.counter(
+            "hvd_coordinator_ready_tensors_total",
+            "tensors released as globally ready")
+        self._m_errors = reg.counter(
+            "hvd_coordinator_error_tensors_total",
+            "tensors failed with per-tensor errors (mismatch/stall)")
+        self._m_stall_warn = reg.counter(
+            "hvd_coordinator_stall_warnings_total",
+            "coordinator stall warnings (round or per-tensor)")
 
     # Per-attempt poll while gathering a round. Short so a stalled round is
     # noticed and attributed within ~stall_warning_s, not after a silent
@@ -327,6 +357,7 @@ class _Coordinator(threading.Thread):
             "stall_inspector.h:39)",
             round_no, elapsed, sorted(missing), detail)
         self.stall_warnings += 1
+        self._m_stall_warn.inc()
 
     def _error_close_round(self, r: int, missing: set[int], elapsed: float):
         """Past stall_shutdown_s: fail every pending tensor with a message
@@ -476,6 +507,9 @@ class _Coordinator(threading.Thread):
                 self.client.put(_ctl_scope(r), "resp",
                                 json.dumps(resp_dict).encode())
                 resp_published = True
+                self._m_responses.inc()
+                self._m_ready.inc(len(ready))
+                self._m_errors.inc(len(errors))
                 if r >= 2:
                     self.client.delete_scope(_ctl_scope(r - 2))
                 if resp_dict.get("shutdown_done"):
@@ -542,6 +576,7 @@ class _Coordinator(threading.Thread):
                     n, sorted(ranks), age, missing)
                 self._stall_warned.add(n)
                 self.stall_warnings += 1
+                self._m_stall_warn.inc()
 
     def _increment(self, name: str, sig: list, rank: int):
         """IncrementTensorCount + mismatch validation (controller.cc:942,

@@ -44,6 +44,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..utils import metrics as metrics_mod
 from . import collectives as C
 
 LOG = logging.getLogger("horovod_tpu")
@@ -183,6 +184,36 @@ class BackgroundRuntime:
         self.bytes_processed = 0
         self.cycles = 0
         self.work_cycles = 0
+        # metric handles resolved ONCE here — the cycle loop and enqueue
+        # path only touch pre-built Counter/Gauge/Histogram objects (O(1)
+        # int ops under one lock, no label-string allocation per event)
+        reg = metrics_mod.get_registry()
+        self.metrics = reg
+        self._m_cycle = reg.histogram(
+            "hvd_cycle_seconds", "working background-cycle duration",
+            buckets=metrics_mod.LATENCY_BUCKETS_S)
+        self._m_queue_depth = reg.gauge(
+            "hvd_queue_depth", "pending entries drained this cycle")
+        self._m_fusion_batch = reg.histogram(
+            "hvd_fusion_batch_size", "tensors fused per allreduce chunk",
+            buckets=metrics_mod.BATCH_BUCKETS)
+        self._m_fused_bytes = reg.histogram(
+            "hvd_fused_chunk_bytes", "bytes per fused allreduce chunk",
+            buckets=metrics_mod.SIZE_BUCKETS_BYTES)
+        self._m_cycles_idle = reg.counter(
+            "hvd_cycles_total", "background cycles", kind="idle")
+        self._m_cycles_work = reg.counter(
+            "hvd_cycles_total", "background cycles", kind="work")
+        self._m_neg_rounds = reg.counter(
+            "hvd_negotiation_rounds_total", "controller negotiation rounds")
+        self._m_neg_errors = reg.counter(
+            "hvd_negotiation_errors_total",
+            "tensors failed by negotiation responses")
+        self._m_op_errors = reg.counter(
+            "hvd_op_errors_total", "eager ops failed during execution")
+        # per-(op, dtype) lazily cached handles: one dict lookup per event
+        self._m_by_op: dict[tuple, tuple] = {}
+        self._m_enq: dict[str, Any] = {}
         self.autotuner = None  # attached by context.init when HOROVOD_AUTOTUNE
         # join state (reference JoinOp / hvd.join(): a rank out of data keeps
         # participating in other ranks' collectives with zero contributions
@@ -255,9 +286,34 @@ class BackgroundRuntime:
                             stall_warning_s=warn_s,
                             stall_shutdown_s=shut_s)
 
+    def _op_metrics(self, op: str, dtype: str) -> tuple:
+        """(bytes_total, latency_hist, ops_total) for one (op, dtype) —
+        created on the first event of that shape, a dict hit afterwards."""
+        key = (op, dtype)
+        handles = self._m_by_op.get(key)
+        if handles is None:
+            reg = self.metrics
+            handles = (
+                reg.counter(f"hvd_{op}_bytes_total",
+                            f"bytes processed by eager {op}", dtype=dtype),
+                reg.histogram(f"hvd_{op}_latency_seconds",
+                              f"eager {op} launch latency",
+                              buckets=metrics_mod.LATENCY_BUCKETS_S,
+                              dtype=dtype),
+                reg.counter(f"hvd_{op}_ops_total",
+                            f"eager {op} operations launched", dtype=dtype),
+            )
+            self._m_by_op[key] = handles
+        return handles
+
     # -- public enqueue API -------------------------------------------------
     def enqueue(self, entry: TensorEntry) -> int:
         entry.handle = self.handles.allocate()
+        c = self._m_enq.get(entry.op)
+        if c is None:
+            c = self._m_enq[entry.op] = self.metrics.counter(
+                "hvd_ops_enqueued_total", "eager ops enqueued", op=entry.op)
+        c.inc()
         if self.stall:
             self.stall.record_pending(entry.name)
         if self.timeline:
@@ -312,6 +368,9 @@ class BackgroundRuntime:
     def run_cycle(self):
         self.cycles += 1
         batch = self.queue.drain()
+        cycle_t0 = time.perf_counter()
+        if batch:
+            self._m_queue_depth.set(len(batch))
         # mark only working cycles: an idle 1 kHz loop would flood the trace
         # with meaningless CYCLE_START instants
         if self.timeline and batch:
@@ -348,7 +407,13 @@ class BackgroundRuntime:
             # no rendezvous store: best-effort deterministic order
             batch.sort(key=lambda e: e.name)
         if not batch:
+            # idle cycles (nothing executed, post-negotiation) tick a
+            # counter only — timing a 1 kHz idle loop would drown the
+            # histogram the same way CYCLE_START instants would flood
+            # the trace
+            self._m_cycles_idle.inc()
             return
+        self._m_cycles_work.inc()
         # split into fusable allreduce groups vs singletons
         fusable: dict[tuple, list[TensorEntry]] = {}
         singles: list[TensorEntry] = []
@@ -368,6 +433,7 @@ class BackgroundRuntime:
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
+        self._m_cycle.observe(time.perf_counter() - cycle_t0)
         # autotune sampling on working cycles (reference: ParameterManager
         # scores each cycle's bytes/sec, parameter_manager.h:88)
         self.work_cycles += 1
@@ -386,6 +452,7 @@ class BackgroundRuntime:
         """
         from .controller import entry_signature
 
+        self._m_neg_rounds.inc()
         for e in batch:
             self._pending[self._wire_name(e)] = e
         sigs = {n: entry_signature(e) for n, e in self._pending.items()}
@@ -408,6 +475,7 @@ class BackgroundRuntime:
         for n, msg in errors.items():
             e = self._pending.pop(n, None)
             if e is not None:
+                self._m_neg_errors.inc()
                 self._finish(e, None, HorovodInternalError(msg))
         out = []
         for n in ready:
@@ -512,6 +580,7 @@ class BackgroundRuntime:
             chunks.append(chunk)
         for chunk in chunks:
             names = [e.name for e in chunk]
+            t0 = time.perf_counter()
             if self.timeline:
                 for n in names:
                     self.timeline.start_activity(n, "FUSED_ALLREDUCE")
@@ -539,6 +608,13 @@ class BackgroundRuntime:
                     fused, e0.reduce_op, e0.process_set or self.process_set,
                     e0.prescale_factor, e0.postscale_factor)
                 self.bytes_processed += fused.nbytes
+                m_bytes, m_lat, m_ops = self._op_metrics(
+                    "allreduce", str(fused.dtype))
+                m_bytes.inc(int(fused.nbytes))
+                m_ops.inc()
+                m_lat.observe(time.perf_counter() - t0)
+                self._m_fusion_batch.observe(len(chunk))
+                self._m_fused_bytes.observe(int(fused.nbytes))
                 # results stay device-side lazy slices: the cycle thread
                 # must not block on completion (async contract; callers
                 # observe readiness per-handle). Jitted unpack: no scalar
@@ -549,6 +625,7 @@ class BackgroundRuntime:
                 for e, p in zip(chunk, parts):
                     self._finish(e, p)
             except Exception as exc:  # fail the whole chunk
+                self._m_op_errors.inc(len(chunk))
                 for e in chunk:
                     self._finish(e, None,
                                  HorovodInternalError(f"fused allreduce failed: {exc}"))
@@ -558,6 +635,7 @@ class BackgroundRuntime:
                         self.timeline.end_activity(n)
 
     def _run_single(self, e: TensorEntry):
+        t0 = time.perf_counter()
         if self.timeline:
             self.timeline.start_activity(e.name, e.op.upper())
         try:
@@ -575,9 +653,19 @@ class BackgroundRuntime:
                 r = C._eager_reducescatter(e.tensor, e.reduce_op, ps)
             else:
                 raise HorovodInternalError(f"unknown op {e.op}")
-            self.bytes_processed += np.asarray(e.tensor).nbytes
+            t = e.tensor
+            nbytes = getattr(t, "nbytes", None)
+            if nbytes is None:
+                nbytes = np.asarray(t).nbytes
+            self.bytes_processed += nbytes
+            m_bytes, m_lat, m_ops = self._op_metrics(
+                e.op, str(getattr(t, "dtype", None) or np.asarray(t).dtype))
+            m_bytes.inc(int(nbytes))
+            m_ops.inc()
+            m_lat.observe(time.perf_counter() - t0)
             self._finish(e, r)
         except Exception as exc:
+            self._m_op_errors.inc()
             self._finish(e, None, HorovodInternalError(str(exc)))
         finally:
             if self.timeline:

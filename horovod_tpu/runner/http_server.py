@@ -90,10 +90,12 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        key = self._key()
+        if key == "metrics":
+            return self._do_metrics()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
-        key = self._key()
         timeout = float(self.headers.get("X-Timeout", "30"))
         deadline = time.monotonic() + timeout
         if self.headers.get("X-Prefix-Read"):
@@ -114,6 +116,39 @@ class _KVHandler(BaseHTTPRequestHandler):
         if skey:
             self.send_header(_secret.DIGEST_HEADER,
                              _secret.response_digest(skey, key, body))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_metrics(self):
+        """``GET /metrics``: Prometheus scrape of this process's registry
+        merged with every snapshot workers pushed under the ``metrics/``
+        KV scope (one per rank, labelled ``rank="k"``). Auth-exempt by
+        design: Prometheus cannot sign the HMAC scheme, and the payload
+        is read-only telemetry — the store's mutating verbs stay signed.
+        The bare path ``metrics`` cannot collide with KV data: every KV
+        key is ``scope/key`` and always contains a slash."""
+        import json
+
+        from ..utils import metrics as metrics_mod
+
+        store = self.server.store  # type: ignore[attr-defined]
+        scope_prefix = metrics_mod.KV_SCOPE + "/"
+        with store.cond:
+            pushed = {k: v for k, v in store.data.items()
+                      if k.startswith(scope_prefix)}
+        snaps = [({}, metrics_mod.get_registry().snapshot())]
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank3"
+            rank = suffix[4:] if suffix.startswith("rank") else suffix
+            try:
+                snaps.append(({"rank": rank}, json.loads(v)))
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next scrape catches up
+        body = metrics_mod.render_snapshots(snaps).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 

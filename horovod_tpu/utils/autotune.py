@@ -36,6 +36,8 @@ from typing import Optional
 
 import numpy as np
 
+from . import metrics as metrics_mod
+
 LOG = logging.getLogger("horovod_tpu")
 
 # log2-space bounds: fusion 1 MiB .. 256 MiB, cycle 0.5 .. 25 ms.
@@ -163,6 +165,17 @@ class Autotuner:
         self._rank = ctl.rank if ctl is not None else 0
         self._opt = (BayesianOptimizer(dims=_DIMS)
                      if self._rank == 0 else None)
+        reg = metrics_mod.get_registry()
+        self._m_fusion = reg.gauge("hvd_autotune_fusion_threshold_bytes",
+                                   "currently applied fusion threshold")
+        self._m_cycle = reg.gauge("hvd_autotune_cycle_time_ms",
+                                  "currently applied cycle time")
+        self._m_score = reg.gauge("hvd_autotune_last_score_bytes_per_sec",
+                                  "last smoothed bytes/sec sample")
+        self._m_samples = reg.counter("hvd_autotune_samples_total",
+                                      "autotune score samples taken")
+        self._m_done = reg.gauge("hvd_autotune_converged",
+                                 "1 once the search has converged")
         if log_path:
             with open(log_path, "w") as f:
                 f.write("sample,fusion_bytes,cycle_ms,hier_allreduce,hier_allgather,score_bytes_per_sec\n")
@@ -194,6 +207,11 @@ class Autotuner:
         cfg.hierarchical_allgather = bool(hier_ag)
 
     def _log(self, score: float):
+        self._m_samples.inc()
+        self._m_score.set(score)
+        self._m_fusion.set(self.runtime.fusion_threshold)
+        self._m_cycle.set(self.runtime.cycle_time_ms)
+        self._m_done.set(1 if (self.done or self._final_submitted) else 0)
         if self.log_path:
             ar, ag = self._get_hier()
             with open(self.log_path, "a") as f:

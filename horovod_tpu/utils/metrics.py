@@ -1,0 +1,387 @@
+"""Unified metrics & telemetry registry: counters, gauges, histograms,
+Prometheus text exposition, JSON snapshots.
+
+The reference exposes runtime health only through the Chrome-trace timeline
+(timeline.cc) and the stall inspector's log lines (stall_inspector.cc) —
+there is no aggregate view a monitoring system can scrape, which is exactly
+the blind spot that let a wedged backend hang for 120 s with nothing in the
+runtime able to surface it (BENCH_r05.json post-mortem). This module is the
+missing L3 observability layer, designed for the eager runtime's hot paths:
+
+- **Dependency-free**: stdlib only (no prometheus_client; the container
+  must not need new packages). The text format follows the Prometheus
+  exposition spec (version 0.0.4) so any standard scraper parses it.
+- **Thread-safe and cheap**: every update is O(1) int/float arithmetic
+  under one shared registry lock (``Histogram.observe`` adds a ``bisect``
+  over a fixed bucket table). Metric *instances* are resolved once — at
+  runtime construction, not per event — so the cycle loop never allocates
+  label strings (the acceptance bound: enqueue-path updates are dict/int
+  ops only).
+- **Two exposures**: ``GET /metrics`` on the rendezvous HTTP server
+  (runner/http_server.py) renders the scrape; ``HOROVOD_METRICS_FILE``
+  periodically dumps the JSON snapshot for post-mortem of wedged runs
+  (``MetricsDumper``). Workers in a launched job additionally push their
+  snapshots into the launcher's KV store so one scrape of the launcher
+  returns every rank's series, labeled ``rank="k"``.
+
+Python API (mirrored as ``hvd.metrics_snapshot()``)::
+
+    from horovod_tpu.utils import metrics
+    reg = metrics.get_registry()
+    c = reg.counter("hvd_allreduce_bytes_total", "wire bytes", dtype="float32")
+    c.inc(4096)
+    snap = reg.snapshot()          # JSON-able structured dict
+    text = reg.render_prometheus() # exposition format 0.0.4
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu")
+
+# Default bucket tables (upper bounds, seconds / bytes / tensor counts).
+# Fixed at metric creation: observe() only bisects, never resizes.
+LATENCY_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+SIZE_BUCKETS_BYTES = (
+    1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22,
+    1 << 24, 1 << 26, 1 << 28, 1 << 30)
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _fmt(v) -> str:
+    """Prometheus sample-value formatting: integers bare, floats via %g."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Base: name + frozen labels + a reference to the registry lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, labels: dict, lock):
+        self.name = name
+        self.help = help_text
+        self.labels = dict(labels)
+        self._lock = lock
+
+
+class Counter(_Metric):
+    """Monotonic counter (reference semantics: bytes_processed-style
+    tallies, but queryable)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_text, labels, lock):
+        super().__init__(name, help_text, labels, lock)
+        self._value = 0
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    """Point-in-time value (queue depth, tuned knobs, oldest pending age)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help_text, labels, lock):
+        super().__init__(name, help_text, labels, lock)
+        self._value = 0
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (cycle time, per-op latency, fused sizes).
+
+    Buckets are upper bounds; the implicit +Inf bucket is always present.
+    ``observe`` is a bisect over the fixed bound table + three int/float
+    adds — no allocation, no resizing.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help_text, labels, lock,
+                 buckets=LATENCY_BUCKETS_S):
+        super().__init__(name, help_text, labels, lock)
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count), ...] ending with ('+Inf', n)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for b, c in zip(self.bounds, counts[:-1]):
+            acc += c
+            out.append((b, acc))
+        out.append(("+Inf", acc + counts[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric table with get-or-create semantics.
+
+    One lock is shared by the registry and every metric it owns: a single
+    uncontended ``threading.Lock`` acquire per update is cheaper than
+    per-metric locks and makes ``snapshot()`` a consistent cut.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key: (name, sorted-label-items tuple) -> metric
+        self._metrics: dict[tuple, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help_text, labels, **kw):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help_text, labels, self._lock, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help_text: str = "", **labels) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = "", **labels) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets=LATENCY_BUCKETS_S, **labels) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets=buckets)
+
+    def reset(self):
+        """Zero every metric in place (instances stay valid — runtime
+        objects cache them). Test/bench helper, not a production path."""
+        with self._lock:
+            for m in self._metrics.values():
+                if isinstance(m, Histogram):
+                    m._counts = [0] * (len(m.bounds) + 1)
+                    m._sum = 0.0
+                    m._count = 0
+                else:
+                    m._value = 0
+
+    # -- snapshots ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Structured, JSON-able consistent cut of every series."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        counters, gauges, hists = [], [], []
+        for m in metrics:
+            if isinstance(m, Histogram):
+                hists.append({"name": m.name, "labels": m.labels,
+                              "buckets": [[b, c] for b, c in m.cumulative()],
+                              "sum": m.sum, "count": m.count})
+            elif isinstance(m, Counter):
+                counters.append({"name": m.name, "labels": m.labels,
+                                 "value": m.value})
+            else:
+                gauges.append({"name": m.name, "labels": m.labels,
+                               "value": m.value})
+        return {"ts": time.time(), "counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def counter_value(self, name: str) -> float:
+        """Sum of a counter family across all label sets (bench helper)."""
+        with self._lock:
+            return sum(m._value for (n, _), m in self._metrics.items()
+                       if n == name and isinstance(m, Counter))
+
+    def render_prometheus(self) -> str:
+        return render_snapshots([({}, self.snapshot())])
+
+    def dump_json(self, path: str):
+        """Atomic-ish JSON dump for post-mortem of wedged runs: write to a
+        sibling temp file, then rename — a reader never sees a torn dump."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+
+def render_snapshots(snapshots) -> str:
+    """Render one exposition from structured snapshots.
+
+    ``snapshots`` is ``[(extra_labels, snapshot_dict), ...]``; series of
+    the same family from different snapshots (ranks) are grouped under one
+    HELP/TYPE header, as the exposition format requires — the launcher's
+    ``/metrics`` merges every pushed worker snapshot through this.
+    """
+    # family name -> (kind, [(labels, payload), ...]); insertion-ordered
+    families: dict[str, tuple[str, list]] = {}
+
+    def add(kind, entry, extra):
+        labels = dict(entry.get("labels") or {})
+        labels.update(extra)
+        fam = families.setdefault(entry["name"], (kind, []))
+        if fam[0] != kind:
+            return  # conflicting kinds across ranks: keep the first
+        fam[1].append((labels, entry))
+
+    for extra, snap in snapshots:
+        for c in snap.get("counters", ()):
+            add("counter", c, extra)
+        for g in snap.get("gauges", ()):
+            add("gauge", g, extra)
+        for h in snap.get("histograms", ()):
+            add("histogram", h, extra)
+
+    lines = []
+    for name, (kind, series) in families.items():
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, entry in series:
+            if kind != "histogram":
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_fmt(entry['value'])}")
+                continue
+            for b, c in entry["buckets"]:
+                bl = dict(labels)
+                bl["le"] = b if isinstance(b, str) else _fmt(b)
+                lines.append(f"{name}_bucket{_label_str(bl)} {c}")
+            lines.append(f"{name}_sum{_label_str(labels)} "
+                         f"{_fmt(entry['sum'])}")
+            lines.append(f"{name}_count{_label_str(labels)} "
+                         f"{entry['count']}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+# --------------------------------------------------------------------------
+# Process-global default registry: one per process, shared by every
+# subsystem, surviving init/shutdown cycles (counters are cumulative over
+# the process lifetime, like any Prometheus target's).
+# --------------------------------------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+# KV-store scope workers push snapshots under (key: "rank{k}"); the
+# rendezvous server's /metrics reads the same scope back.
+KV_SCOPE = "metrics"
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+class MetricsDumper:
+    """Background publisher: periodic ``HOROVOD_METRICS_FILE`` JSON dumps
+    and (in a launched job) snapshot pushes into the launcher's KV store
+    under ``metrics/rank{k}``, so the launcher's ``GET /metrics`` shows
+    every rank. Both are best-effort — telemetry must never take down the
+    job it is observing.
+    """
+
+    KV_SCOPE = KV_SCOPE
+
+    def __init__(self, registry: MetricsRegistry, file_path: str = "",
+                 interval_s: float = 30.0, kv_client=None,
+                 rank: int = 0):
+        self.registry = registry
+        self.file_path = file_path
+        self.interval_s = max(float(interval_s), 0.5)
+        self.kv_client = kv_client
+        self.rank = rank
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-metrics")
+        self._thread.start()
+
+    def flush(self):
+        """One synchronous dump+push (shutdown path and tests)."""
+        if self.file_path:
+            try:
+                self.registry.dump_json(self.file_path)
+            except OSError as e:
+                LOG.warning("metrics file dump failed: %s", e)
+        if self.kv_client is not None:
+            try:
+                self.kv_client.put(
+                    self.KV_SCOPE, f"rank{self.rank}",
+                    json.dumps(self.registry.snapshot()).encode())
+            except Exception as e:
+                LOG.debug("metrics KV push failed: %s", e)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.flush()  # final dump: the post-mortem artifact

@@ -10,6 +10,11 @@ inspector watches the *eager async* queue: a tensor enqueued but not executed
 for ``warning_time_s`` (default 60, same as reference) triggers a warning;
 ``shutdown_time_s > 0`` escalates to `StalledTensorError`, failing pending
 work like the reference's forced shutdown.
+
+Wired into the metrics registry (utils/metrics.py): the oldest pending age
+is a gauge a scraper can alert on *before* the warning threshold, and
+warning/shutdown escalations are counters — the post-mortem signal the
+BENCH_r05 wedged-backend hang had no way to emit.
 """
 
 from __future__ import annotations
@@ -18,8 +23,19 @@ import logging
 import time
 
 from ..common.exceptions import StalledTensorError
+from . import metrics as metrics_mod
 
 LOG = logging.getLogger("horovod_tpu")
+
+
+def _age_distribution(ages: list[float]) -> str:
+    """Compact pending-queue age summary for the warning message:
+    count + min/median/max, enough to tell one straggler from a wedge."""
+    if not ages:
+        return "no tensors pending"
+    s = sorted(ages)
+    return (f"{len(s)} pending (age min/median/max = "
+            f"{s[0]:.1f}/{s[len(s) // 2]:.1f}/{s[-1]:.1f} s)")
 
 
 class StallInspector:
@@ -30,6 +46,20 @@ class StallInspector:
         self.disabled = disabled
         self._pending: dict[str, float] = {}
         self._warned: set[str] = set()
+        reg = metrics_mod.get_registry()
+        self._m_oldest = reg.gauge(
+            "hvd_stall_oldest_pending_age_seconds",
+            "age of the oldest tensor still waiting to execute")
+        self._m_pending = reg.gauge(
+            "hvd_stall_pending_tensors", "tensors in the pending table")
+        self._m_warnings = reg.counter(
+            "hvd_stall_warnings_total", "stall warnings emitted")
+        self._m_stalled = reg.counter(
+            "hvd_stall_stalled_tensors_total",
+            "tensors that crossed the warning threshold")
+        self._m_shutdowns = reg.counter(
+            "hvd_stall_shutdowns_total",
+            "warning-to-shutdown escalations (StalledTensorError raised)")
 
     def record_pending(self, name: str):
         self._pending.setdefault(name, time.monotonic())
@@ -41,22 +71,34 @@ class StallInspector:
     def check(self):
         """Called once per background cycle (reference: invoked from
         ComputeResponseList, controller.cc:294)."""
-        if self.disabled or not self._pending:
+        if self.disabled:
+            return
+        if not self._pending:
+            self._m_oldest.set(0.0)
+            self._m_pending.set(0)
             return
         now = time.monotonic()
+        ages = [now - t for t in self._pending.values()]
+        self._m_oldest.set(max(ages))
+        self._m_pending.set(len(ages))
         stalled = [(n, now - t) for n, t in self._pending.items()
                    if now - t > self.warning_time_s]
+        dist = _age_distribution(ages) if stalled else ""
         for name, age in stalled:
             if name not in self._warned:
                 LOG.warning(
                     "Tensor %s has been pending for %.0f s without executing. "
                     "This may indicate that not all processes are submitting "
-                    "the same collectives in the same order.", name, age)
+                    "the same collectives in the same order. Queue: %s.",
+                    name, age, dist)
                 self._warned.add(name)
+                self._m_warnings.inc()
+                self._m_stalled.inc()
         if self.shutdown_time_s > 0:
             dead = [n for n, t in self._pending.items()
                     if now - t > self.shutdown_time_s]
             if dead:
+                self._m_shutdowns.inc()
                 err = StalledTensorError(
                     f"tensors stalled beyond shutdown time: {sorted(dead)}")
                 err.names = sorted(dead)

@@ -92,6 +92,47 @@ def test_timeline_writes_events(tmp_path):
     assert isinstance(events, list) and len(events) > 3
 
 
+def test_timeline_simplequeue_fallback(tmp_path, monkeypatch):
+    """With the native SPSC ring unavailable, the queue.SimpleQueue
+    fallback path carries every event start->write->stop and the output
+    is still valid Chrome trace-event JSON."""
+    import json
+
+    import horovod_tpu._native as native_mod
+    from horovod_tpu.utils.timeline import Timeline
+
+    monkeypatch.setattr(native_mod, "lib", lambda: None)
+    f = tmp_path / "timeline_fallback.json"
+    tl = Timeline(str(f), mark_cycles=True)
+    assert tl._native is None  # the fallback is actually in play
+    assert tl.enabled
+    for i in range(5):
+        tl.negotiate_start(f"grad/{i}", "ALLREDUCE")
+        tl.negotiate_end(f"grad/{i}")
+        tl.start_activity(f"grad/{i}", "QUEUED")
+        tl.end_activity(f"grad/{i}")
+    tl.mark_cycle_start()
+    tl.close()
+    assert not tl.enabled
+
+    events = json.loads(f.read_text())
+    assert isinstance(events, list)
+    # 5 process_name metadata + 5x4 lane events + 1 cycle marker + closer
+    assert len(events) >= 26
+    by_ph = {}
+    for ev in events:
+        by_ph.setdefault(ev.get("ph"), 0)
+        by_ph[ev.get("ph")] += 1
+    assert by_ph["B"] == 10 and by_ph["E"] == 10  # nothing dropped
+    assert by_ph["M"] == 5 and by_ph["i"] == 1
+    names = {ev["args"]["name"] for ev in events if ev.get("ph") == "M"}
+    assert names == {f"grad/{i}" for i in range(5)}
+    # every event carries the chrome-trace required keys
+    for ev in events:
+        if ev:  # the trailing {} closer
+            assert "ph" in ev and "pid" in ev
+
+
 def test_async_fused_allreduce_device_resident_no_host_copy():
     """Device-resident jax.Array gradients through the ASYNC queue fuse on
     device (jnp.concatenate), never the host fusion buffer (reference NCCL
