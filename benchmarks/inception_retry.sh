@@ -4,12 +4,18 @@
 # when the tunnel dropped ~11:40). Probes every 2 min; on recovery runs
 # the inception3 leg, then re-runs the default resnet50 leg so
 # bench_result.json ends the session holding the flagship artifact.
+# BOTH legs are validated the same way (a "metric" token present, no
+# "fallback" in the output): an unvalidated flagship rerun that silently
+# fell back to CPU used to exit 0 with a junk artifact. A failed leg
+# retries within the same deadline loop; the banked inception artifact
+# is not re-burned by a flagship-only retry.
 cd "$(dirname "$0")/.." || exit 1
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
 export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
 DEADLINE=$(( $(date +%s) + ${1:-7} * 3600 ))
 LOG=benchmarks/inception_retry.log
+INC_JSON=benchmarks/bench_r5_inception3.json
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 90 python -c "
 import jax, jax.numpy as jnp
@@ -17,25 +23,40 @@ d = jax.devices()[0]
 assert 'tpu' in (d.platform + ' ' + d.device_kind).lower(), d
 float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64))))" >/dev/null 2>&1; then
     echo "TUNNEL-UP $(date +%H:%M:%S)" | tee -a "$LOG"
-    # outer budget must contain the whole chain: 120 s probe + 3300 s
-    # TPU child + 2400 s CPU fallback + margin, else a TPU-child
-    # timeout leaves bench.py SIGTERMed mid-fallback with an orphaned
-    # child still running (120 + 3300 + 2400 = 5820, so >= 6300)
-    if HVD_BENCH_MODEL=inception3 HVD_BENCH_CHILD_TIMEOUT=3300 \
-        timeout 6300 python bench.py \
-        > benchmarks/.inc_r5.tmp 2>>"$LOG" \
-        && grep -q '"metric"' benchmarks/.inc_r5.tmp \
-        && ! grep -q fallback benchmarks/.inc_r5.tmp; then
-      mv benchmarks/.inc_r5.tmp benchmarks/bench_r5_inception3.json
-      echo "INCEPTION-BANKED $(date +%H:%M:%S)" | tee -a "$LOG"
-      timeout 3000 python bench.py >> "$LOG" 2>&1
+    if [ ! -f "$INC_JSON" ]; then
+      # outer budget must contain the whole chain: 120 s probe + 3300 s
+      # TPU child + 2400 s CPU fallback + margin, else a TPU-child
+      # timeout leaves bench.py SIGTERMed mid-fallback with an orphaned
+      # child still running (120 + 3300 + 2400 = 5820, so >= 6300)
+      if HVD_BENCH_MODEL=inception3 HVD_BENCH_CHILD_TIMEOUT=3300 \
+          timeout 6300 python bench.py \
+          > benchmarks/.inc_r5.tmp 2>>"$LOG" \
+          && grep -q '"metric"' benchmarks/.inc_r5.tmp \
+          && ! grep -q fallback benchmarks/.inc_r5.tmp; then
+        mv benchmarks/.inc_r5.tmp "$INC_JSON"
+        echo "INCEPTION-BANKED $(date +%H:%M:%S)" | tee -a "$LOG"
+      else
+        echo "attempt failed $(date +%H:%M:%S)" >> "$LOG"
+        sleep 120
+        continue
+      fi
+    fi
+    if timeout 3000 python bench.py \
+        > benchmarks/.flagship_r5.tmp 2>>"$LOG" \
+        && grep -q '"metric"' benchmarks/.flagship_r5.tmp \
+        && ! grep -q fallback benchmarks/.flagship_r5.tmp; then
+      cat benchmarks/.flagship_r5.tmp >> "$LOG"
+      rm -f benchmarks/.flagship_r5.tmp
       echo "FLAGSHIP-RERUN-DONE $(date +%H:%M:%S)" | tee -a "$LOG"
       exit 0
     fi
-    echo "attempt failed $(date +%H:%M:%S)" >> "$LOG"
+    # loud, and NOT exit 0: the inception artifact is banked, so the
+    # retry loop re-attempts only this leg until the deadline
+    echo "FLAGSHIP-RERUN-FAILED $(date +%H:%M:%S); retrying" | tee -a "$LOG"
   else
     echo "probe down $(date +%H:%M:%S)" >> "$LOG"
   fi
   sleep 120
 done
 echo "RETRY-EXPIRED $(date +%H:%M:%S)" | tee -a "$LOG"
+exit 1

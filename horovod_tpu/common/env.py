@@ -42,6 +42,19 @@ HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
 HOROVOD_METRICS_FILE = "HOROVOD_METRICS_FILE"
 HOROVOD_METRICS_DUMP_INTERVAL = "HOROVOD_METRICS_DUMP_INTERVAL"
 HOROVOD_METRICS_PUSH = "HOROVOD_METRICS_PUSH"
+# chaos fault-point spec + deterministic seed (utils/faults.py; see
+# docs/fault_tolerance.md for the grammar)
+HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
+HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
+# global overrides for every control-plane retry policy (utils/retry.py);
+# call sites pass per-site defaults, these widen all of them at once
+HOROVOD_RETRY_MAX_ATTEMPTS = "HOROVOD_RETRY_MAX_ATTEMPTS"
+HOROVOD_RETRY_DEADLINE = "HOROVOD_RETRY_DEADLINE"
+HOROVOD_RETRY_BASE_DELAY = "HOROVOD_RETRY_BASE_DELAY"
+# elastic respawn-before-blacklist budget: per-host transient-failure
+# retries and the backoff scale between respawn rounds (elastic/driver.py)
+HOROVOD_ELASTIC_RESPAWN_ATTEMPTS = "HOROVOD_ELASTIC_RESPAWN_ATTEMPTS"
+HOROVOD_ELASTIC_RESPAWN_BACKOFF = "HOROVOD_ELASTIC_RESPAWN_BACKOFF"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"

@@ -57,3 +57,31 @@ class StalledTensorError(RuntimeError):
     (/root/reference/horovod/common/stall_inspector.cc; env
     ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``).
     """
+
+
+class FaultInjectedError(RuntimeError):
+    """A chaos fault fired at a ``HOROVOD_FAULT_SPEC`` fault point
+    (``utils/faults.py``). Only ever raised when fault injection is
+    explicitly configured; production code paths never see it.
+
+    ``drop``-mode faults raise the ``FaultInjectedConnectionError``
+    subclass (also a ``ConnectionError``) so transport retry policies
+    classify them exactly like a real dropped socket.
+    """
+
+
+class RetriesExhaustedError(RuntimeError):
+    """A :class:`horovod_tpu.utils.retry.Retrier` ran out of budget
+    (attempts or deadline) with no attempt ever classified retryable —
+    e.g. the overall deadline expired before the first try. When attempts
+    *were* made, the Retrier re-raises the last real exception instead,
+    so callers keep their existing except clauses.
+    """
+
+    def __init__(self, site: str, attempts: int, elapsed_s: float):
+        super().__init__(
+            f"retry budget exhausted at {site!r}: {attempts} attempt(s) "
+            f"over {elapsed_s:.1f}s")
+        self.site = site
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s

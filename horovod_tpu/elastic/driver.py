@@ -30,9 +30,12 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..common import env as env_schema
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from ..runner.http_server import RendezvousServer
+from ..utils import faults as faults_mod
 from ..utils import metrics as metrics_mod
+from ..utils import retry as retry_mod
 from .discovery import HostDiscoveryScript, HostManager
 from .registration import FAILURE, SUCCESS, WorkerStateRegistry
 
@@ -83,16 +86,39 @@ class _SubprocessWorker(WorkerHandle):
 
 
 class ElasticDriver:
+    """Round-based elastic driver with respawn-before-blacklist.
+
+    A worker failure used to blacklist its host on the first strike —
+    one transient SSH drop or TPU-VM preemption blip permanently shrank
+    the job. Failures are now a per-host strike count: below
+    ``respawn_retries`` (``HOROVOD_ELASTIC_RESPAWN_ATTEMPTS``, default 1)
+    the host is *retried* in the next round after a full-jitter backoff
+    (``HOROVOD_ELASTIC_RESPAWN_BACKOFF`` scales it); only exhausting the
+    budget blacklists. A worker exiting 0 clears its host's strikes, so
+    the budget is per failure burst, not per job lifetime.
+    """
+
     def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
-                 reset_limit: Optional[int] = None):
+                 reset_limit: Optional[int] = None,
+                 respawn_retries: Optional[int] = None,
+                 respawn_backoff_s: Optional[float] = None):
         self.host_manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
         self.reset_limit = reset_limit
+        self.respawn_retries = (
+            respawn_retries if respawn_retries is not None
+            else env_schema.get_int(
+                env_schema.HOROVOD_ELASTIC_RESPAWN_ATTEMPTS, 1))
+        self.respawn_backoff_s = (
+            respawn_backoff_s if respawn_backoff_s is not None
+            else env_schema.get_float(
+                env_schema.HOROVOD_ELASTIC_RESPAWN_BACKOFF, 1.0))
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
         self._prev_host_order: list[str] = []
         self._prev_slot_ranks: set[int] = set()
+        self._host_strikes: dict[str, int] = {}
         self._epoch = 0
         self._resets = 0
         self._stop = threading.Event()
@@ -109,6 +135,12 @@ class ElasticDriver:
         self._m_failures = reg.counter(
             "hvd_elastic_worker_failures_total",
             "worker processes that exited nonzero")
+        self._m_respawns = reg.counter(
+            "hvd_elastic_respawns_total",
+            "failed hosts retried (respawn-before-blacklist)")
+        self._m_blacklists = reg.counter(
+            "hvd_elastic_blacklists_total",
+            "hosts blacklisted after exhausting their respawn budget")
         self._m_epoch = reg.gauge("hvd_elastic_epoch",
                                   "current elastic incarnation")
         self._m_world = reg.gauge("hvd_elastic_world_size",
@@ -173,12 +205,25 @@ class ElasticDriver:
             self.current_slots = slots
             self.registry.reset()
             workers: dict[int, tuple[SlotInfo, WorkerHandle]] = {}
+            spawn_failed = None  # (slot, exception)
             for slot in slots:
                 env = base_env_fn(slot)
                 env["HOROVOD_ELASTIC_EPOCH"] = str(self._epoch)
                 env["HOROVOD_ELASTIC"] = "1"
-                workers[slot.rank] = (slot, create_worker(slot, env))
-            rc = self._monitor_round(workers)
+                try:
+                    faults_mod.fault_point("elastic.spawn")
+                    workers[slot.rank] = (slot, create_worker(slot, env))
+                except Exception as e:
+                    # SSH refused / binary missing / preempted mid-spawn:
+                    # same lifecycle as a worker failure on that host
+                    spawn_failed = (slot, e)
+                    break
+            if spawn_failed is not None:
+                slot, e = spawn_failed
+                self._terminate(workers)
+                rc = self._host_failure(slot, f"spawn failed: {e!r}")
+            else:
+                rc = self._monitor_round(workers)
             if rc is not None:
                 return rc
             # membership changed or failure: next round
@@ -191,12 +236,17 @@ class ElasticDriver:
         """None → start a new round; int → final exit code."""
         last_discovery = 0.0
         alive = dict(workers)
-        failed_host = None
+        failed: Optional[tuple[SlotInfo, int]] = None
         while alive:
             now = time.monotonic()
             if now - last_discovery >= DISCOVER_INTERVAL_S:
                 last_discovery = now
-                if self.host_manager.update_available_hosts():
+                try:
+                    faults_mod.fault_point("elastic.heartbeat")
+                    changed = self.host_manager.update_available_hosts()
+                except faults_mod.FaultInjectedError:
+                    changed = False  # skipped heartbeat: detection delayed
+                if changed:
                     LOG.info("elastic: host membership changed; resetting")
                     self._resets += 1
                     self._m_resets.inc()
@@ -212,25 +262,66 @@ class ElasticDriver:
                 if rc == 0:
                     self.registry.record(f"{slot.hostname}:{slot.local_rank}",
                                          SUCCESS)
+                    # a clean exit proves the host healthy: the respawn
+                    # budget is per failure burst, not per job lifetime
+                    self._host_strikes.pop(slot.hostname, None)
                 else:
                     self.registry.record(f"{slot.hostname}:{slot.local_rank}",
                                          FAILURE)
-                    failed_host = slot.hostname
+                    failed = (slot, rc)
                     break
-            if failed_host:
-                LOG.warning("elastic: worker failed on %s; blacklisting",
-                            failed_host)
-                self._m_failures.inc()
-                self.host_manager.blacklist(failed_host)
-                self._resets += 1
-                self._m_resets.inc()
-                self.bump_epoch()
+            if failed:
+                slot, rc = failed
                 self._terminate(alive)
-                if self.host_manager.available_slots() >= self.min_np:
-                    return None
-                return 1
+                return self._host_failure(slot, f"exited with code {rc}")
             time.sleep(0.05)
         return 0  # every worker exited 0
+
+    def _host_failure(self, slot: SlotInfo, what: str) -> Optional[int]:
+        """Strike the failed slot's host: respawn it (with backoff) while
+        the per-host budget lasts, blacklist when it is exhausted. The
+        log line carries rank, local slot, failure detail, and the
+        blacklist decision so a post-mortem needs no KV-log archaeology.
+        None → start a new round; int → final exit code."""
+        host = slot.hostname
+        self._m_failures.inc()
+        strikes = self._host_strikes.get(host, 0) + 1
+        self._host_strikes[host] = strikes
+        budget = self.respawn_retries
+        if strikes > budget:
+            decision = (
+                "blacklisting (first strike; respawn retries disabled)"
+                if budget == 0 else
+                f"blacklisting (respawn retries exhausted: "
+                f"{strikes - 1}/{budget})")
+            delay = 0.0
+            self.host_manager.blacklist(host)
+            self._m_blacklists.inc()
+        else:
+            # full-jitter exponential backoff between respawn rounds:
+            # preempted-VM replacements and SSH daemons both need a
+            # breath, and synchronized multi-host failures must not
+            # hammer the discovery/spawn path in lockstep
+            delay = retry_mod.RetryPolicy(
+                base_delay_s=self.respawn_backoff_s,
+                max_delay_s=max(self.respawn_backoff_s, 30.0),
+            ).backoff_delay(strikes)
+            decision = (f"respawning before blacklist "
+                        f"(attempt {strikes}/{budget}, "
+                        f"backoff {delay:.1f}s)")
+            self._m_respawns.inc()
+        LOG.warning(
+            "elastic: worker rank %d (slot %s:%d) %s; %s",
+            slot.rank, host, slot.local_rank, what, decision)
+        self._resets += 1
+        self._m_resets.inc()
+        self.bump_epoch()
+        if self.host_manager.available_slots() < self.min_np:
+            return 1
+        if delay > 0:
+            # interruptible: stop() must not wait out the backoff
+            self._stop.wait(delay)
+        return None
 
     def _terminate(self, alive):
         for slot, h in alive.values():

@@ -53,9 +53,12 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Optional
 
+from ..utils import faults as faults_mod
 from ..utils import metrics as metrics_mod
+from ..utils import retry as retry_mod
 
 LOG = logging.getLogger("horovod_tpu")
 
@@ -138,6 +141,14 @@ class KVController:
     # slow rank stalls the round, never desyncs it.
     RESPONSE_TIMEOUT_S = 300.0
 
+    # Per-attempt server-side block while polling for the round response.
+    # The overall RESPONSE_TIMEOUT_S budget is spent as bounded re-polls
+    # with backoff (utils/retry.py) instead of one flat blocking GET: a
+    # store blip or dropped socket mid-wait costs one re-poll, not the
+    # whole round, and the worker's liveness is observable per attempt
+    # (hvd_retry_attempts_total{site="controller.poll"}).
+    POLL_ATTEMPT_S = 10.0
+
     # Marker payload for the steady-state fast path: "my submitted set is
     # identical to last round's". The moral of the reference response cache's
     # bitvector sync (response_cache.h:45, controller.cc:139-237): repeated
@@ -209,12 +220,12 @@ class KVController:
             else:
                 wire = payload
                 self._m_cache_miss.inc()
+            faults_mod.fault_point("controller.submit")
             self.client.put(_ctl_scope(r), f"ready/{self.rank}", wire)
             self.bytes_sent += len(wire)
             self._m_wire_bytes.inc(len(wire))
             self._last_payload = payload
-            resp = json.loads(self.client.get(_ctl_scope(r), "resp",
-                                              timeout=self.poll_timeout))
+            resp = json.loads(self._poll_response(r))
         except Exception:
             self.broken = True
             raise
@@ -245,6 +256,33 @@ class KVController:
             except Exception as e:  # tuning must never break the lockstep
                 LOG.warning("on_params failed: %s", e)
         return resp
+
+    def _poll_response(self, r: int) -> bytes:
+        """Block for round ``r``'s response under the unified retry
+        policy: short server-side blocking GETs (POLL_ATTEMPT_S each)
+        re-polled with full-jitter backoff until ``poll_timeout``
+        expires. Replaces the round-1 flat 300 s GET — same overall
+        deadline and the same exception surface at exhaustion (the last
+        404/connection error re-raises, marking the controller broken in
+        ``negotiate``), but a transient store fault mid-wait now costs
+        one re-poll instead of the round."""
+        deadline = self.poll_timeout
+        start = time.monotonic()
+        policy = retry_mod.RetryPolicy(
+            max_attempts=None, deadline_s=deadline,
+            base_delay_s=0.05, max_delay_s=1.0)
+
+        def attempt():
+            faults_mod.fault_point("controller.poll")
+            remaining = deadline - (time.monotonic() - start)
+            # the deadline/4 term keeps short budgets (tests, tuned-down
+            # HOROVOD_RESPONSE_TIMEOUT_S) genuinely re-polling instead of
+            # one flat blocking GET that eats the whole budget
+            per = max(0.1, min(self.POLL_ATTEMPT_S, deadline / 4.0,
+                               remaining))
+            return self.client.get(_ctl_scope(r), "resp", timeout=per)
+
+        return retry_mod.Retrier("controller.poll", policy).call(attempt)
 
     def drain_shutdown(self):
         """Reference shutdown barrier (operations.cc RunLoopOnce exits

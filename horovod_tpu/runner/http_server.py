@@ -30,6 +30,8 @@ from typing import Optional
 from urllib.error import HTTPError
 from urllib.parse import unquote
 
+from ..utils import faults as _faults
+from ..utils import retry as _retry
 from . import secret as _secret
 
 
@@ -272,7 +274,16 @@ class KVStoreClient:
     two requests per worker, and re-dialing TCP for each (urllib has no
     pooling) dominated round latency at np≥8 (measured in
     benchmarks/controller_scaling.py). A stale socket (store restart,
-    idle timeout) gets one transparent reconnect."""
+    idle timeout) is retried transparently on a fresh connection under
+    the unified retry policy (utils/retry.py): one extra attempt by
+    default (``HOROVOD_RETRY_MAX_ATTEMPTS`` widens it), idempotent verbs
+    only — the KV protocol's GET/PUT/DELETE are all last-write-wins
+    idempotent, but anything else must surface its first failure."""
+
+    # HTTP verbs safe to re-send after a torn exchange: every KV
+    # operation is set-a-key / read-a-key (last-write-wins), so a replay
+    # cannot double-apply. A non-idempotent verb gets exactly one attempt.
+    IDEMPOTENT_VERBS = frozenset({"GET", "PUT", "DELETE", "HEAD"})
 
     def __init__(self, addr: str, port: int,
                  secret_key: Optional[str] = None):
@@ -283,46 +294,61 @@ class KVStoreClient:
                         else _secret.env_secret())
         self._local = threading.local()
 
-    def _request(self, method: str, path: str, body: Optional[bytes],
+    def _attempt(self, method: str, path: str, body: Optional[bytes],
                  headers: dict, timeout: float):
         import http.client
 
-        last_exc = None
-        for attempt in (0, 1):
-            conn = getattr(self._local, "conn", None)
-            if conn is None:
-                conn = http.client.HTTPConnection(self.addr, self.port,
-                                                  timeout=timeout)
-                try:
-                    conn.connect()
-                    # latency-bound request/response pairs: without
-                    # NODELAY, Nagle holds the second write segment for
-                    # the peer's delayed ACK (~40 ms per exchange,
-                    # measured in benchmarks/controller_scaling.py)
-                    conn.sock.setsockopt(socket.IPPROTO_TCP,
-                                         socket.TCP_NODELAY, 1)
-                except OSError:
-                    pass  # connect() retried by conn.request below
-                self._local.conn = conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self.addr, self.port,
+                                              timeout=timeout)
             try:
-                conn.timeout = timeout
-                if conn.sock is not None:
-                    conn.sock.settimeout(timeout)
-                conn.request(method, "/" + path, body=body,
-                             headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                return resp.status, resp.headers, data
-            except (OSError, http.client.HTTPException) as e:
-                # stale keep-alive socket: drop it and retry once on a
-                # fresh connection
-                last_exc = e
-                try:
-                    conn.close()
-                except Exception:
-                    pass
-                self._local.conn = None
-        raise last_exc
+                conn.connect()
+                # latency-bound request/response pairs: without
+                # NODELAY, Nagle holds the second write segment for
+                # the peer's delayed ACK (~40 ms per exchange,
+                # measured in benchmarks/controller_scaling.py)
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # connect() retried by conn.request below
+            self._local.conn = conn
+        try:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            conn.request(method, "/" + path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, resp.headers, data
+        except (OSError, http.client.HTTPException):
+            # stale keep-alive socket: drop it so the retry (if the
+            # policy grants one) dials fresh
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+            raise
+
+    def _request(self, method: str, path: str, body: Optional[bytes],
+                 headers: dict, timeout: float, site: str = ""):
+        site = site or f"kv.{method.lower()}"
+        if method in self.IDEMPOTENT_VERBS:
+            # one transparent reconnect by default; the env knob widens it
+            policy = _retry.RetryPolicy.from_env(max_attempts=2,
+                                                 base_delay_s=0.05,
+                                                 max_delay_s=1.0)
+        else:
+            # non-idempotent: a replay could double-apply — never retry,
+            # not even when HOROVOD_RETRY_MAX_ATTEMPTS widens the rest
+            policy = _retry.RetryPolicy(max_attempts=1)
+
+        def attempt():
+            _faults.fault_point(site)
+            return self._attempt(method, path, body, headers, timeout)
+
+        return _retry.Retrier(site, policy).call(attempt)
 
     def _headers(self, method: str, path: str, body: bytes = b"",
                  exclude: str = "", mode: str = "") -> dict:
@@ -352,6 +378,10 @@ class KVStoreClient:
 
     def put(self, scope: str, key: str, value: bytes):
         path = f"{scope}/{key}"
+        # torn-write chaos hook BEFORE signing: the mangled payload is
+        # stored "successfully" with a valid digest, exactly the artifact
+        # a writer crash mid-value leaves for readers to tolerate
+        value = _faults.corrupt("kv.put", value)
         status, _, _ = self._request(
             "PUT", path, value, self._headers("PUT", path, value), 30.0)
         self._check_status(status, path, f"PUT {path}")
@@ -388,7 +418,7 @@ class KVStoreClient:
                    "X-Timeout": str(timeout)}
         headers.update(self._headers("GET", path, mode=mode))
         status, rhdrs, body = self._request("GET", path, None, headers,
-                                            timeout + 10)
+                                            timeout + 10, site="kv.wait")
         self._check_status(status, path, f"GET(prefix) {path}")
         if self._secret and not _secret.check_digest(
                 self._secret, rhdrs.get(_secret.DIGEST_HEADER),

@@ -1,0 +1,239 @@
+"""Fault-injection ("chaos") layer for the control plane.
+
+The reference Horovod's resilience machinery (stall inspector,
+elastic blacklist-and-restart) is only ever exercised by *production*
+failures; there is no way to provoke a dropped socket, a slow poll, or a
+preempted worker deterministically in a test. This module is that missing
+piece: named **fault points** sit at the control-plane seams (KV
+get/put/wait, controller poll/submit, elastic spawn/heartbeat, metrics
+push) and are driven entirely by one env knob::
+
+    HOROVOD_FAULT_SPEC="kv.get:drop#1,controller.poll:delay=250ms@0.5,elastic.spawn:fail#1"
+
+Spec grammar — comma-separated entries, each::
+
+    site:mode[=arg][@gate][#count]
+
+- ``site``  — fault-point name (see SITES below for the instrumented set).
+- ``mode``  — ``drop``  (raise a connection-level error, as if the peer
+  vanished mid-exchange), ``delay`` (sleep ``arg``, default 50 ms; accepts
+  ``5s`` / ``250ms`` / bare seconds), ``error``/``fail`` (raise
+  ``FaultInjectedError``; ``arg`` is the message), ``torn`` (truncate a
+  payload at a write site — exercised via :func:`corrupt`).
+- ``@gate`` — when to fire: a float ``<= 1`` is a per-hit probability
+  (deterministic: drawn from an RNG seeded by ``HOROVOD_FAULT_SEED`` +
+  site + rank, so a failing chaos run replays exactly); an integer ``> 1``
+  fires on every Nth hit. Default: every hit.
+- ``#count`` — total trigger budget (default unlimited).
+  ``elastic.spawn:fail#1`` fails exactly the first spawn, then heals —
+  the shape of a transient SSH/preemption blip.
+
+Unconfigured, every fault point is an inert no-op (one env-dict lookup),
+and no ``hvd_fault_*`` metric exists in the registry; each *trigger*
+increments ``hvd_fault_injected_total{site,mode}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from ..common.exceptions import FaultInjectedError
+
+LOG = logging.getLogger("horovod_tpu")
+
+HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
+HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
+
+#: Instrumented fault-point names (documentation + spec validation aid).
+SITES = (
+    "kv.get", "kv.put", "kv.wait", "kv.delete",
+    "controller.poll", "controller.submit",
+    "elastic.spawn", "elastic.heartbeat",
+    "metrics.push",
+)
+
+MODES = ("drop", "delay", "error", "fail", "torn")
+
+
+class FaultInjectedConnectionError(FaultInjectedError, ConnectionError):
+    """Injected connection-level fault (``drop`` mode): an OSError
+    subclass, so transport-layer retry policies classify it exactly like
+    a real dropped socket."""
+
+
+def _parse_duration(s: str) -> float:
+    s = s.strip().lower()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    return float(s)
+
+
+class _Rule:
+    """One parsed spec entry with its trigger state (hits / budget)."""
+
+    def __init__(self, site: str, mode: str, arg: str,
+                 gate: Optional[str], count: Optional[int], seed: int):
+        self.site = site
+        self.mode = "error" if mode == "fail" else mode
+        if self.mode not in ("drop", "delay", "error", "torn"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.arg = arg
+        self.delay_s = _parse_duration(arg) if self.mode == "delay" and arg \
+            else 0.05
+        self.probability: Optional[float] = None
+        self.every_nth: Optional[int] = None
+        if gate is not None:
+            g = float(gate)
+            if g <= 1.0:
+                self.probability = g
+            else:
+                self.every_nth = int(g)
+        self.remaining = count  # None = unlimited
+        self.hits = 0
+        # deterministic per-(seed, site, rank) stream: a failing chaos run
+        # replays bit-for-bit, and ranks draw distinct sequences
+        rank = os.environ.get("HOROVOD_RANK", "0")
+        self._rng = random.Random(f"{seed}:{site}:{mode}:{rank}")
+        self._lock = threading.Lock()
+        self._metric = None
+
+    def should_fire(self) -> bool:
+        with self._lock:
+            self.hits += 1
+            if self.remaining is not None and self.remaining <= 0:
+                return False
+            if self.probability is not None:
+                if self._rng.random() >= self.probability:
+                    return False
+            elif self.every_nth is not None:
+                if self.hits % self.every_nth != 0:
+                    return False
+            if self.remaining is not None:
+                self.remaining -= 1
+            return True
+
+    def record(self):
+        # lazily registered so an unconfigured run registers NO
+        # hvd_fault_* series at all (acceptance criterion)
+        if self._metric is None:
+            from . import metrics as metrics_mod
+
+            self._metric = metrics_mod.get_registry().counter(
+                "hvd_fault_injected_total", "chaos faults injected",
+                site=self.site, mode=self.mode)
+        self._metric.inc()
+
+    def fire(self):
+        self.record()
+        if self.mode == "delay":
+            LOG.debug("fault %s: injected %.3fs delay", self.site,
+                      self.delay_s)
+            time.sleep(self.delay_s)
+        elif self.mode == "drop":
+            raise FaultInjectedConnectionError(
+                f"injected connection drop at fault point {self.site!r} "
+                f"(HOROVOD_FAULT_SPEC)")
+        elif self.mode == "error":
+            raise FaultInjectedError(
+                self.arg or f"injected error at fault point {self.site!r} "
+                            f"(HOROVOD_FAULT_SPEC)")
+        # "torn" only acts through corrupt()
+
+
+class _FaultState:
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.rules: dict[str, list[_Rule]] = {}
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, rest = entry.partition(":")
+            site = site.strip()
+            if not rest:
+                raise ValueError(
+                    f"bad HOROVOD_FAULT_SPEC entry {entry!r}: want "
+                    "site:mode[=arg][@gate][#count]")
+            count: Optional[int] = None
+            if "#" in rest:
+                rest, _, c = rest.rpartition("#")
+                count = int(c)
+            gate: Optional[str] = None
+            if "@" in rest:
+                rest, _, gate = rest.rpartition("@")
+            mode, _, arg = rest.partition("=")
+            self.rules.setdefault(site, []).append(
+                _Rule(site, mode.strip(), arg.strip(), gate, count, seed))
+
+
+_state: Optional[_FaultState] = None
+_state_lock = threading.Lock()
+
+
+def _active() -> Optional[_FaultState]:
+    spec = os.environ.get(HOROVOD_FAULT_SPEC, "")
+    if not spec:
+        return None
+    global _state
+    st = _state
+    if st is not None and st.spec == spec:
+        return st
+    with _state_lock:
+        if _state is None or _state.spec != spec:
+            try:
+                _state = _FaultState(
+                    spec, int(os.environ.get(HOROVOD_FAULT_SEED, "0") or 0))
+            except ValueError as e:
+                # a malformed spec must not take the job down — chaos
+                # tooling is opt-in observability, loud but harmless
+                LOG.error("ignoring malformed %s=%r: %s",
+                          HOROVOD_FAULT_SPEC, spec, e)
+                _state = _FaultState("", 0)
+                _state.spec = spec  # cache the rejection
+        return _state
+
+
+def reset():
+    """Drop parsed spec state (test helper: re-arm trigger budgets)."""
+    global _state
+    with _state_lock:
+        _state = None
+
+
+def fault_point(site: str):
+    """Chaos hook: no-op unless ``HOROVOD_FAULT_SPEC`` names ``site``.
+
+    May sleep (``delay``) or raise (``drop`` → connection-level error,
+    ``error`` → :class:`FaultInjectedError`). Call it at the top of the
+    operation the fault should hit, inside any retry scope that is
+    supposed to absorb it.
+    """
+    st = _active()
+    if st is None:
+        return
+    for rule in st.rules.get(site, ()):
+        if rule.mode != "torn" and rule.should_fire():
+            rule.fire()
+
+
+def corrupt(site: str, data: bytes) -> bytes:
+    """Torn-write hook for payload-carrying sites: returns ``data``
+    truncated to half its length when a ``torn`` rule fires (the
+    half-written value a crashed writer leaves behind), else unchanged."""
+    st = _active()
+    if st is None:
+        return data
+    for rule in st.rules.get(site, ()):
+        if rule.mode == "torn" and rule.should_fire():
+            rule.record()
+            LOG.debug("fault %s: torn write (%d -> %d bytes)", site,
+                      len(data), len(data) // 2)
+            return data[: len(data) // 2]
+    return data

@@ -369,9 +369,17 @@ class MetricsDumper:
                 LOG.warning("metrics file dump failed: %s", e)
         if self.kv_client is not None:
             try:
-                self.kv_client.put(
-                    self.KV_SCOPE, f"rank{self.rank}",
+                # chaos hooks: a dropped push is absorbed here (telemetry
+                # is best-effort by contract); a torn push is stored and
+                # must be skipped by the /metrics merge on read
+                from . import faults as faults_mod
+
+                faults_mod.fault_point("metrics.push")
+                payload = faults_mod.corrupt(
+                    "metrics.push",
                     json.dumps(self.registry.snapshot()).encode())
+                self.kv_client.put(self.KV_SCOPE, f"rank{self.rank}",
+                                   payload)
             except Exception as e:
                 LOG.debug("metrics KV push failed: %s", e)
 

@@ -27,3 +27,17 @@ def _hvd_session():
     hvd.init()
     yield
     hvd.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fault_spec_guard(request):
+    """Chaos isolation: a fault spec leaking out of a chaos test would
+    silently inject faults into every later test. Fail the victim loudly,
+    naming the leaked spec, instead of letting it flake."""
+    leaked = os.environ.get("HOROVOD_FAULT_SPEC")
+    if leaked and "chaos" not in request.keywords:
+        pytest.fail(
+            f"HOROVOD_FAULT_SPEC={leaked!r} leaked into non-chaos test "
+            f"{request.node.nodeid}: a chaos test (tests/test_faults.py) "
+            "failed to clean up its environment")
+    yield

@@ -168,23 +168,34 @@ def test_driver_all_success():
     driver.stop()
 
 
-def test_driver_blacklists_failed_host_and_restarts():
+def test_driver_respawns_failed_host_then_blacklists():
+    """Respawn-before-blacklist lifecycle: the first failure on a host
+    retries it (transient blip), a second failure within the same burst
+    exhausts the budget and blacklists."""
     disc = FixedHosts({"a": 1, "b": 1})
-    driver = ElasticDriver(disc, min_np=1)
+    driver = ElasticDriver(disc, min_np=1, respawn_retries=1,
+                           respawn_backoff_s=0.01)
     sc = Scenario()
     t, result = run_driver_async(driver, sc)
     assert wait_for(lambda: len(sc.workers) == 2)
-    # worker on host b fails
+    # worker on host b fails once: transient — host retried, not removed
     for slot, w in sc.workers:
         if slot.hostname == "b":
             w.finish(1)
-    # a new round launches only on host a
-    assert wait_for(lambda: len(sc.workers) == 3)
-    assert driver.host_manager.is_blacklisted("b")
+    assert wait_for(lambda: len(sc.workers) == 4)  # respawn round: a AND b
+    assert not driver.host_manager.is_blacklisted("b")
     round2 = sc.workers[2:]
-    assert all(s.hostname == "a" for s, _ in round2)
-    assert all(s.size == 1 for s, _ in round2)
-    for _, w in round2:
+    assert {s.hostname for s, _ in round2} == {"a", "b"}
+    # b fails again: respawn budget (1) exhausted -> blacklist
+    for slot, w in round2:
+        if slot.hostname == "b":
+            w.finish(1)
+    assert wait_for(lambda: len(sc.workers) == 5)  # final round: a only
+    assert driver.host_manager.is_blacklisted("b")
+    round3 = sc.workers[4:]
+    assert all(s.hostname == "a" for s, _ in round3)
+    assert all(s.size == 1 for s, _ in round3)
+    for _, w in round3:
         w.finish(0)
     t.join(timeout=10)
     assert result["rc"] == 0
@@ -214,7 +225,9 @@ def test_driver_membership_change_triggers_new_round():
 
 def test_driver_min_np_violation_fails():
     disc = FixedHosts({"a": 1})
-    driver = ElasticDriver(disc, min_np=1)
+    # respawn_retries=0 keeps first-strike blacklisting (operators who
+    # want the old reference behavior set HOROVOD_ELASTIC_RESPAWN_ATTEMPTS=0)
+    driver = ElasticDriver(disc, min_np=1, respawn_retries=0)
     sc = Scenario()
     t, result = run_driver_async(driver, sc)
     assert wait_for(lambda: len(sc.workers) == 1)
@@ -333,8 +346,9 @@ print(f"ELASTIC-E2E-DONE rank={r} step={state.step} incarnation={incarnation}")
 
 def test_elastic_crash_restart_end_to_end(tmp_path):
     """Full restart-based recovery through the REAL elastic launcher: a
-    worker hard-crashes mid-training, the driver blacklists its 'host',
-    relaunches the world on the surviving host alias, and workers resume
+    worker hard-crashes mid-training, the driver strikes its 'host'
+    (respawn-before-blacklist: one transient crash retries the host
+    rather than removing it), relaunches the world, and workers resume
     from the committed state store — training completes all 6 steps
     (reference integration/test_elastic_* shape)."""
     import os
