@@ -392,6 +392,20 @@ def main():
                    if h["name"] == "hvd_fusion_batch_size"), None)
     extras["fused_batches"] = int(fusion["count"]) if fusion else 0
     extras["fused_tensors"] = int(fusion["sum"]) if fusion else 0
+    # steady-state fast path telemetry (docs/performance.md): are cycles
+    # actually replaying compiled fused-chunk plans, and is the staging
+    # ring being reused instead of allocating per pack?
+    from horovod_tpu.utils import metrics as _metrics_mod
+
+    _reg = _metrics_mod.get_registry()
+    plan_hits = _reg.counter_value("hvd_fused_plan_hits_total")
+    plan_misses = _reg.counter_value("hvd_fused_plan_misses_total")
+    plan_total = plan_hits + plan_misses
+    extras["fused_plan_hit_rate"] = (
+        round(plan_hits / plan_total, 4) if plan_total else None)
+    extras["fused_plan_lookups"] = int(plan_total)
+    extras["staging_ring_reuses"] = int(
+        _reg.counter_value("hvd_staging_reuse_total"))
     extras["allreduce_gbps_semantics"] = (
         "wire bytes (hvd_allreduce_bytes_total delta / wall time); the "
         "compressed config therefore reports post-compression bytes")
@@ -555,23 +569,15 @@ def _parent_main() -> int:
     env = dict(os.environ)
     env[_BENCH_CHILD] = "1"
     args = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
-    err = ""
-    # stage 1: a 120 s probe child decides whether the backend is usable
-    # at all — a wedged tunnel HANGS inside backend init (it does not
-    # raise), and burning the full bench timeout on that hang could
-    # outlast the caller's own patience
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('BENCH-PROBE-OK')"],
-            env=dict(os.environ), timeout=120,
-            capture_output=True, text=True)
-        probe_ok = "BENCH-PROBE-OK" in probe.stdout
-        if not probe_ok:
-            err = (probe.stderr or "backend probe failed")[-400:]
-    except subprocess.TimeoutExpired:
-        probe_ok = False
-        err = "backend probe hung for 120 s (wedged tunnel)"
+    # stage 1: a probe child decides whether the backend is usable at all
+    # — a wedged tunnel HANGS inside backend init (it does not raise), and
+    # burning the full bench timeout on that hang could outlast the
+    # caller's own patience. Shared helper: timeout rides
+    # HOROVOD_BACKEND_PROBE_TIMEOUT and the verdict is cached per process
+    # (BENCH_r05 burned 120 s per probe on a wedged tunnel).
+    from horovod_tpu.common.util import probe_backend
+
+    probe_ok, err = probe_backend()
     # compile-heavy legs (inception3's heterogeneous conv stack) can
     # need more than the default 2400 s on a remote-compile tunnel;
     # campaign/retry harnesses raise this per run

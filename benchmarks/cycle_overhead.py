@@ -1,0 +1,112 @@
+"""Per-cycle dispatch overhead of the background cycle loop (pure CPU).
+
+Measures what ISSUE 3 changed: the host-side cost of dispatching one
+fused-allreduce cycle for a synthetic 20-tensor workload, with the
+compiled fused-chunk plans enabled (steady-state replay: one program
+dispatch per chunk) vs the legacy eager chain (per-tensor ravels +
+concat + reduce + separate unpack dispatch). No TPU needed — overhead
+here is host work, which is exactly what the fast path removes.
+
+Run directly for a JSON comparison line:
+
+    JAX_PLATFORMS=cpu python benchmarks/cycle_overhead.py
+
+or import ``measure()`` (the tier-1 smoke test in
+tests/test_fusion_plan.py does, with a small cycle count, so fast-path
+regressions surface in CI rather than on a chip window).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 20 mixed-shape f32 tensors (~400 KiB total), all under one fusion chunk
+WORKLOAD_SHAPES = [
+    (256, 64), (1024,), (128, 32), (4096,), (512, 8),
+    (2048,), (64, 64), (8192,), (32, 128), (1024, 4),
+    (300,), (17, 19), (2500,), (128,), (640, 2),
+    (5000,), (96, 96), (1,), (777,), (2222,),
+]
+
+
+def _runtime(plans_enabled: bool):
+    """A private, non-started BackgroundRuntime driven synchronously —
+    run_cycle() is called inline so the timing covers exactly one cycle's
+    dispatch work, with no background-thread scheduling jitter."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import context as ctx_mod
+    from horovod_tpu.common.env import RuntimeConfig
+    from horovod_tpu.ops.queue import BackgroundRuntime
+
+    hvd.init()
+    cfg = RuntimeConfig()
+    cfg.stall_check_disable = True
+    cfg.fused_plan_disable = not plans_enabled
+    return BackgroundRuntime(ctx_mod.global_process_set(), cfg)
+
+
+def measure(plans_enabled: bool, cycles: int = 50, warmup: int = 5) -> dict:
+    """Drive ``cycles`` steady-state cycles of the 20-tensor workload and
+    return per-cycle dispatch stats plus the plan-cache hit rate."""
+    import numpy as np
+
+    from horovod_tpu.ops.queue import TensorEntry
+    from horovod_tpu.utils import metrics as metrics_mod
+
+    rt = _runtime(plans_enabled)
+    reg = metrics_mod.get_registry()
+    arrays = [np.random.default_rng(i).standard_normal(s).astype(np.float32)
+              for i, s in enumerate(WORKLOAD_SHAPES)]
+
+    def one_cycle():
+        handles = []
+        for i, a in enumerate(arrays):
+            e = TensorEntry(name=f"cycle_overhead.{i}", op="allreduce",
+                            tensor=a)
+            handles.append(rt.enqueue(e))
+        t0 = time.perf_counter()
+        rt.run_cycle()
+        dt = time.perf_counter() - t0
+        for h in handles:  # completion is NOT part of dispatch overhead
+            rt.handles.wait(h)
+        return dt
+
+    for _ in range(warmup):
+        one_cycle()
+    h0 = reg.counter_value("hvd_fused_plan_hits_total")
+    m0 = reg.counter_value("hvd_fused_plan_misses_total")
+    times = [one_cycle() for _ in range(cycles)]
+    hits = reg.counter_value("hvd_fused_plan_hits_total") - h0
+    misses = reg.counter_value("hvd_fused_plan_misses_total") - m0
+    lookups = hits + misses
+    return {
+        "plans_enabled": plans_enabled,
+        "tensors_per_cycle": len(arrays),
+        "cycles": cycles,
+        "dispatch_ms_median": round(statistics.median(times) * 1e3, 4),
+        "dispatch_ms_mean": round(statistics.fmean(times) * 1e3, 4),
+        "dispatch_ms_p90": round(
+            sorted(times)[max(0, int(len(times) * 0.9) - 1)] * 1e3, 4),
+        "plan_hit_rate": round(hits / lookups, 4) if lookups else None,
+    }
+
+
+def main() -> int:
+    fast = measure(plans_enabled=True)
+    legacy = measure(plans_enabled=False)
+    out = {"fast_path": fast, "legacy": legacy}
+    if fast["dispatch_ms_median"] > 0:
+        out["legacy_over_fast"] = round(
+            legacy["dispatch_ms_median"] / fast["dispatch_ms_median"], 2)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

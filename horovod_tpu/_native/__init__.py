@@ -89,38 +89,219 @@ def lib():
     return _lib
 
 
+def _pack_into(arrays, buf) -> None:
+    """Batched memcpy of contiguous ``arrays`` into uint8 ``buf`` (native
+    parallel memcpy when available, numpy loop otherwise)."""
+    import numpy as np
+
+    L = lib()
+    if L is None or len(arrays) < 2:
+        off = 0
+        for a in arrays:
+            buf[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+            off += a.nbytes
+    else:
+        n = len(arrays)
+        srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+        sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+        L.hvd_pack(srcs, sizes, n, buf.ctypes.data)
+
+
+_PENDING = object()  # slot leased, completion token not yet attached
+
+_staging_handles = None
+
+
+def _staging_metrics():
+    """(acquire{ring}, acquire{alloc}, reuse, inflight gauge) — resolved
+    lazily and failure-tolerant so _native never depends on the metrics
+    registry being importable."""
+    global _staging_handles
+    if _staging_handles is None:
+        try:
+            from ..utils import metrics as metrics_mod
+
+            reg = metrics_mod.get_registry()
+            _staging_handles = (
+                reg.counter("hvd_staging_acquire_total",
+                            "staging buffer acquisitions", source="ring"),
+                reg.counter("hvd_staging_acquire_total",
+                            "staging buffer acquisitions", source="alloc"),
+                reg.counter("hvd_staging_reuse_total",
+                            "staging ring slots reused"),
+                reg.gauge("hvd_staging_inflight",
+                          "staging slots leased or awaiting transfer"),
+            )
+        except Exception:  # pragma: no cover - metrics always importable
+            class _Null:
+                def inc(self, n=1):
+                    pass
+
+                def set(self, v):
+                    pass
+
+            _staging_handles = (_Null(), _Null(), _Null(), _Null())
+    return _staging_handles
+
+
+class _StagingLease:
+    """Handle for one leased ring slot. ``retire(token)`` returns the slot:
+    with ``token=None`` the slot frees immediately; with a token exposing
+    ``is_ready()`` (a jax.Array) the slot stays unavailable until the
+    async consumer of the staged bytes has finished with them."""
+
+    __slots__ = ("_ring", "_index", "_done")
+
+    def __init__(self, ring, index):
+        self._ring = ring
+        self._index = index
+        self._done = False
+
+    def retire(self, token=None):
+        if self._done:
+            return
+        self._done = True
+        self._ring._retire(self._index, token)
+
+
+class StagingRing:
+    """Ring of persistent host staging buffers for the fusion pack path.
+
+    The legacy ``FusionBuffer.pack`` allocated a fresh buffer per call
+    because the eager collective consumes the staged bytes asynchronously
+    (the device transfer — or, on the CPU backend, the zero-copy device
+    array itself — may alias the host memory). The ring keeps that safety
+    with in-flight tracking instead of allocation: a slot is handed out
+    again only once its completion token reports ``is_ready()``, i.e. the
+    compiled program that read the staged bytes has produced its outputs.
+    Slots are allocated lazily at full capacity (grow-only), so an idle
+    runtime with a 128 MiB threshold does not pin slots×128 MiB."""
+
+    def __init__(self, nbytes: int, slots: int = 4):
+        self.capacity = max(0, int(nbytes))
+        self.slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._bufs = [None] * self.slots
+        self._tokens = [None] * self.slots
+        self._used = [False] * self.slots
+
+    def _inflight(self) -> int:
+        n = 0
+        for t in self._tokens:
+            if t is _PENDING:
+                n += 1
+            elif t is not None and not self._token_done(t):
+                n += 1
+        return n
+
+    @staticmethod
+    def _token_done(token) -> bool:
+        try:
+            return bool(token.is_ready())
+        except Exception:
+            return True  # dead/unknown token: don't wedge the slot forever
+
+    def acquire(self, total: int):
+        """Lease a slot with >= ``total`` bytes. Returns ``(buf, lease)``
+        where ``buf`` is a uint8 view of exactly ``total`` bytes, or
+        ``(None, None)`` when no slot fits (oversize chunk or all slots
+        busy) — callers fall back to a fresh allocation."""
+        import numpy as np
+
+        m = _staging_metrics()
+        if total > self.capacity:
+            m[1].inc()
+            return None, None
+        with self._lock:
+            for i in range(self.slots):
+                t = self._tokens[i]
+                if t is _PENDING:
+                    continue
+                if t is not None and not self._token_done(t):
+                    continue
+                if self._bufs[i] is None:
+                    self._bufs[i] = np.empty(self.capacity, dtype=np.uint8)
+                self._tokens[i] = _PENDING
+                m[0].inc()
+                if self._used[i]:
+                    m[2].inc()
+                self._used[i] = True
+                m[3].set(self._inflight())
+                return self._bufs[i][:total], _StagingLease(self, i)
+        m[1].inc()
+        return None, None
+
+    def _retire(self, index: int, token):
+        with self._lock:
+            self._tokens[index] = token
+            _staging_metrics()[3].set(self._inflight())
+
+    def resize(self, nbytes: int):
+        """Adopt a new capacity (fusion threshold changed). Existing
+        buffers are dropped — in-flight consumers hold their own
+        references, so the memory survives until they finish."""
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            if nbytes == self.capacity:
+                return
+            self.capacity = nbytes
+            self._bufs = [None] * self.slots
+            self._tokens = [None] * self.slots
+            self._used = [False] * self.slots
+
+
 class FusionBuffer:
     """Fusion pack/unpack helper (reference fusion_buffer_manager.h:40 +
     the MemcpyIn/Out pair, collective_operations.h:65-88): batched,
     multi-threaded memcpy of N tensors into one flat buffer via the native
-    core. Each ``pack`` returns a *freshly allocated* buffer: the eager
-    collective consumes its input asynchronously (and the device transfer
-    may alias the host memory), so a reused scratch buffer could be
-    overwritten before the in-flight collective reads it."""
+    core. ``pack_leased`` stages into a persistent ring slot (reused only
+    after the in-flight consumer finishes — see StagingRing); ``pack``
+    keeps the legacy fresh-allocation contract for callers that hold the
+    buffer indefinitely."""
 
-    def __init__(self, nbytes: int = 0):
-        self.nbytes = nbytes  # advisory initial size; kept for API parity
+    def __init__(self, nbytes: int = 0, slots: int = None):
+        if slots is None:
+            slots = 4
+            try:
+                from ..common import env as env_mod
+
+                slots = env_mod.get_int(
+                    env_mod.HOROVOD_STAGING_RING_SLOTS, 4)
+            except Exception:
+                pass
+        self.nbytes = nbytes
+        self.ring = StagingRing(nbytes, slots)
+
+    def resize(self, nbytes: int):
+        self.nbytes = nbytes
+        self.ring.resize(nbytes)
+
+    def pack_leased(self, arrays):
+        """Pack into a leased ring slot. Returns ``(flat, lease)`` where
+        ``flat`` is the packed array viewed as the first array's dtype and
+        ``lease`` is a ``_StagingLease`` to retire once the consumer's
+        completion token exists — or ``None`` when the ring was bypassed
+        (oversize/busy) and the buffer is freshly owned."""
+        import numpy as np
+
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        total = sum(a.nbytes for a in arrays)
+        buf, lease = self.ring.acquire(total)
+        if buf is None:
+            buf = np.empty(total, dtype=np.uint8)
+        _pack_into(arrays, buf)
+        return buf.view(arrays[0].dtype), lease
 
     def pack(self, arrays) -> "np.ndarray":
-        """Pack contiguous arrays into one flat array (dtype of the first
-        array) using the native parallel memcpy when available."""
+        """Pack contiguous arrays into one flat freshly-allocated array
+        (dtype of the first array): the caller owns the result with no
+        reuse hazard, at the cost of an allocation per call."""
         import numpy as np
 
         arrays = [np.ascontiguousarray(a) for a in arrays]
         total = sum(a.nbytes for a in arrays)
         buf = np.empty(total, dtype=np.uint8)
-        L = lib()
-        if L is None or len(arrays) < 2:
-            off = 0
-            for a in arrays:
-                buf[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
-                off += a.nbytes
-        else:
-            n = len(arrays)
-            srcs = (ctypes.c_void_p * n)(
-                *[a.ctypes.data for a in arrays])
-            sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
-            L.hvd_pack(srcs, sizes, n, buf.ctypes.data)
+        _pack_into(arrays, buf)
         return buf.view(arrays[0].dtype)
 
     @staticmethod

@@ -55,6 +55,13 @@ HOROVOD_RETRY_BASE_DELAY = "HOROVOD_RETRY_BASE_DELAY"
 # retries and the backoff scale between respawn rounds (elastic/driver.py)
 HOROVOD_ELASTIC_RESPAWN_ATTEMPTS = "HOROVOD_ELASTIC_RESPAWN_ATTEMPTS"
 HOROVOD_ELASTIC_RESPAWN_BACKOFF = "HOROVOD_ELASTIC_RESPAWN_BACKOFF"
+# steady-state fast path (docs/performance.md): staging-ring slot count,
+# escape hatch disabling compiled fused-chunk plans (legacy per-cycle
+# eager dispatch), and the backend liveness-probe timeout in seconds
+# (common/util.py probe_backend; the verdict is cached per process)
+HOROVOD_STAGING_RING_SLOTS = "HOROVOD_STAGING_RING_SLOTS"
+HOROVOD_FUSED_PLAN_DISABLE = "HOROVOD_FUSED_PLAN_DISABLE"
+HOROVOD_BACKEND_PROBE_TIMEOUT = "HOROVOD_BACKEND_PROBE_TIMEOUT"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -144,6 +151,10 @@ class RuntimeConfig:
     metrics_file: str = ""
     metrics_dump_interval_s: float = 30.0
     metrics_push: bool = True
+    # steady-state fast path: persistent staging slots per FusionBuffer and
+    # the fused-plan escape hatch (legacy per-cycle eager dispatch)
+    staging_ring_slots: int = 4
+    fused_plan_disable: bool = False
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -177,4 +188,7 @@ class RuntimeConfig:
         c.metrics_dump_interval_s = get_float(HOROVOD_METRICS_DUMP_INTERVAL,
                                               c.metrics_dump_interval_s)
         c.metrics_push = get_bool(HOROVOD_METRICS_PUSH, True)
+        c.staging_ring_slots = get_int(HOROVOD_STAGING_RING_SLOTS,
+                                       c.staging_ring_slots)
+        c.fused_plan_disable = get_bool(HOROVOD_FUSED_PLAN_DISABLE)
         return c

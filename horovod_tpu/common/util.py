@@ -77,6 +77,60 @@ def warn_64bit_narrowing(dtype) -> None:
             "docs/frameworks.md.", dtype)
 
 
+# probe_backend verdict, cached for the process lifetime: a wedged TPU
+# tunnel makes EVERY probe hang for the full timeout, and one stall per
+# process is the most a verdict is worth (BENCH_r05 burned 120 s on it;
+# repeated probes would burn it again per call site)
+_BACKEND_PROBE_VERDICT: dict = {}
+
+PROBE_SENTINEL = "BENCH-PROBE-OK"
+
+
+def probe_backend_timeout() -> float:
+    """Backend-probe timeout in seconds (HOROVOD_BACKEND_PROBE_TIMEOUT,
+    default 120 — the historical hardcoded value)."""
+    from . import env as env_mod
+
+    t = env_mod.get_float(env_mod.HOROVOD_BACKEND_PROBE_TIMEOUT, 120.0)
+    return t if t > 0 else 120.0
+
+
+def probe_backend(timeout_s: float | None = None, force: bool = False):
+    """Decide whether the JAX backend is usable, in a THROWAWAY subprocess.
+
+    A wedged TPU tunnel hangs inside backend init instead of raising, so
+    an in-process probe would hang the caller. Returns ``(ok, err)`` where
+    ``err`` is a short diagnostic when ``ok`` is False. The verdict is
+    cached for the process lifetime (``force=True`` re-probes)."""
+    import subprocess
+    import sys
+
+    if not force and "verdict" in _BACKEND_PROBE_VERDICT:
+        return _BACKEND_PROBE_VERDICT["verdict"]
+    if timeout_s is None:
+        timeout_s = probe_backend_timeout()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             f"import jax; jax.devices(); print('{PROBE_SENTINEL}')"],
+            env=dict(os.environ), timeout=timeout_s,
+            capture_output=True, text=True)
+        ok = PROBE_SENTINEL in p.stdout
+        err = "" if ok else (p.stderr or "backend probe failed")[-400:]
+    except Exception as e:  # TimeoutExpired, OSError
+        ok = False
+        err = (f"backend probe hung for {timeout_s:g} s (wedged tunnel)"
+               if isinstance(e, subprocess.TimeoutExpired)
+               else f"backend probe failed to launch: {e}")
+    _BACKEND_PROBE_VERDICT["verdict"] = (ok, err)
+    return ok, err
+
+
+def clear_backend_probe_cache():
+    """Forget the cached probe verdict (test helper)."""
+    _BACKEND_PROBE_VERDICT.clear()
+
+
 def module_namespace(mod, **extra):
     """A SimpleNamespace copy of ``mod``'s public attributes with
     framework-specific additions grafted on — used by the shims to

@@ -129,6 +129,36 @@ from collections import OrderedDict
 
 _EAGER_CACHE: "OrderedDict" = OrderedDict()
 
+# fused-chunk plan bookkeeping (see FusedChunkPlan below): plans share the
+# LRU with every other eager program, so evictions must be visible from
+# both insertion sites
+_PLAN_KEY = "fused_plan"
+_plan_count = 0
+_plan_metric_handles = None
+
+
+def _plan_metrics():
+    """(hits, misses, lru_evictions, invalidations, cache_size_gauge) —
+    resolved once; the cycle loop touches only prebuilt handles."""
+    global _plan_metric_handles
+    if _plan_metric_handles is None:
+        from ..utils import metrics as metrics_mod
+
+        reg = metrics_mod.get_registry()
+        _plan_metric_handles = (
+            reg.counter("hvd_fused_plan_hits_total",
+                        "fused-chunk plan cache hits"),
+            reg.counter("hvd_fused_plan_misses_total",
+                        "fused-chunk plans compiled (cache misses)"),
+            reg.counter("hvd_fused_plan_evictions_total",
+                        "fused-chunk plans evicted", reason="lru"),
+            reg.counter("hvd_fused_plan_evictions_total",
+                        "fused-chunk plans evicted", reason="invalidation"),
+            reg.gauge("hvd_fused_plan_cache_size",
+                      "fused-chunk plans currently cached"),
+        )
+    return _plan_metric_handles
+
 
 def _cache_capacity() -> int:
     try:
@@ -137,21 +167,53 @@ def _cache_capacity() -> int:
         return 1024
 
 
+def _evict_over_capacity():
+    global _plan_count
+    cap = _cache_capacity()
+    while cap > 0 and len(_EAGER_CACHE) > cap:
+        k, _ = _EAGER_CACHE.popitem(last=False)
+        if k and k[0] == _PLAN_KEY:
+            _plan_count -= 1
+            m = _plan_metrics()
+            m[2].inc()
+            m[4].set(_plan_count)
+
+
 def _cached(key, builder):
     fn = _EAGER_CACHE.get(key)
     if fn is None:
         fn = builder()
         _EAGER_CACHE[key] = fn
-        cap = _cache_capacity()
-        while cap > 0 and len(_EAGER_CACHE) > cap:
-            _EAGER_CACHE.popitem(last=False)
+        _evict_over_capacity()
     else:
         _EAGER_CACHE.move_to_end(key)
     return fn
 
 
 def clear_eager_cache():
+    global _plan_count
     _EAGER_CACHE.clear()
+    _plan_count = 0
+    if _plan_metric_handles is not None:
+        _plan_metric_handles[4].set(0)
+
+
+def invalidate_fused_plans() -> int:
+    """Drop every cached fused-chunk plan (keep plain eager programs).
+
+    Called when the fusion threshold changes: chunk boundaries move, so
+    previously compiled plans can never be looked up again — leaving them
+    would let dead programs crowd live ones out of the shared LRU."""
+    global _plan_count
+    stale = [k for k in _EAGER_CACHE if k and k[0] == _PLAN_KEY]
+    for k in stale:
+        del _EAGER_CACHE[k]
+    if stale:
+        _plan_count = 0
+        m = _plan_metrics()
+        m[3].inc(len(stale))
+        m[4].set(0)
+    return len(stale)
 
 
 def unpack_flat(red, sizes: tuple, shapes: tuple):
@@ -239,6 +301,97 @@ def _hierarchical_enabled(kind: str) -> bool:
             else cfg.hierarchical_allgather)
 
 
+def _allreduce_hier(op, ps: ProcessSet, nproc: int) -> bool:
+    """Whether the two-level (intra-chip × cross-process) allreduce applies."""
+    return (_hierarchical_enabled("allreduce")
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM)
+            and ps.mesh_2d is not None
+            and ps.mesh_2d.shape[LOCAL_AXIS] > 1
+            # the cross-axis hypercube needs a power-of-2 world
+            and not (op == ReduceOp.ADASUM and (nproc & (nproc - 1))))
+
+
+def _allreduce_body(ps: ProcessSet, op, prescale_factor, postscale_factor,
+                    hier: bool):
+    """Traceable ``g[nproc, ...] -> reduced`` shared by ``_eager_allreduce``
+    and the fused-chunk plans (which fuse this body with the per-tensor
+    unpack slices into one program). Returns an un-jitted function."""
+
+    def reduce_flat(g):
+        g = g * prescale_factor if prescale_factor != 1.0 else g
+        if op == ReduceOp.AVERAGE:
+            r = jnp.mean(g, axis=0)
+        elif op == ReduceOp.SUM:
+            # dtype=: jnp.sum widens small ints (u8→u32); the wire
+            # contract returns the caller's dtype (reference preserves
+            # the MPI datatype end to end)
+            r = jnp.sum(g, axis=0, dtype=g.dtype)
+        elif op == ReduceOp.MIN:
+            r = jnp.min(g, axis=0)
+        elif op == ReduceOp.MAX:
+            r = jnp.max(g, axis=0)
+        elif op == ReduceOp.PRODUCT:
+            r = jnp.prod(g, axis=0, dtype=g.dtype)
+        elif op == ReduceOp.ADASUM:
+            from .adasum import adasum_tree_reduce
+
+            r = adasum_tree_reduce(g)
+        else:
+            raise ValueError(f"unsupported op {op}")
+        return r * postscale_factor if postscale_factor != 1.0 else r
+
+    if not hier:
+        return reduce_flat
+
+    # Two-level path (HOROVOD_HIERARCHICAL_ALLREDUCE; reference
+    # NCCLHierarchicalAllreduce, nccl_operations.cc:188-370:
+    # ReduceScatter-intra → Allreduce-cross → Allgather-intra). Each
+    # local chip takes 1/nlocal of the row, psums it over the process
+    # axis (cross traffic / nlocal per chip), then the reduced shards
+    # are allgathered back over the intra-process (ICI) axis.
+    mesh = ps.mesh_2d
+    nl = mesh.shape[LOCAL_AXIS]
+
+    def per_chip(gl):  # gl: [1, ...] — this process's row
+        x0 = gl[0]
+        flat = x0.reshape(-1)
+        pad = (-flat.size) % nl
+        padded = jnp.pad(flat, (0, pad))
+        csz = padded.size // nl
+        li = lax.axis_index(LOCAL_AXIS)
+        chunk = lax.dynamic_slice(padded, (li * csz,), (csz,))
+        if prescale_factor != 1.0:
+            chunk = chunk * prescale_factor
+        if op == ReduceOp.ADASUM:
+            # two-level Adasum (reference adasum_gpu_operations.cc):
+            # each local chip already holds a 1/nl chunk of this
+            # process's contribution; the cross-process hypercube
+            # runs on chunks with dot/norm scalars psummed over the
+            # local axis, so coefficients describe the full vectors
+            # and the result EQUALS flat Adasum — with cross (DCN)
+            # traffic per chip divided by nl
+            from .adasum import adasum_allreduce
+
+            red = adasum_allreduce(chunk, PROC_AXIS,
+                                   norm_axis=LOCAL_AXIS)
+        else:
+            red = lax.psum(chunk, PROC_AXIS)
+            if op == ReduceOp.AVERAGE:
+                red = red / ps.cross_size
+        if postscale_factor != 1.0:
+            red = red * postscale_factor
+        full = _traced_allgather(red[None], LOCAL_AXIS)
+        full = full.reshape(-1)[:flat.size]
+        return full.reshape(x0.shape)
+
+    def f(g):
+        return jax.shard_map(per_chip, mesh=mesh,
+                             in_specs=P(PROC_AXIS),
+                             out_specs=P(), check_vma=False)(g)
+
+    return f
+
+
 def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
     xl = _to_local(x)
     nproc = ps.cross_size
@@ -259,92 +412,155 @@ def _eager_allreduce(x, op, ps: ProcessSet, prescale_factor, postscale_factor):
             pass  # adasum over a single contributor is identity
         return jnp.asarray(out)
 
-    hier = (_hierarchical_enabled("allreduce")
-            and op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM)
-            and ps.mesh_2d is not None
-            and ps.mesh_2d.shape[LOCAL_AXIS] > 1
-            # the cross-axis hypercube needs a power-of-2 world
-            and not (op == ReduceOp.ADASUM and (nproc & (nproc - 1))))
+    hier = _allreduce_hier(op, ps, nproc)
     key = ("allreduce", ps.name, xl.shape, str(xl.dtype), int(op),
            float(prescale_factor), float(postscale_factor), hier)
 
     def build():
-        def reduce_flat(g):
-            g = g * prescale_factor if prescale_factor != 1.0 else g
-            if op == ReduceOp.AVERAGE:
-                r = jnp.mean(g, axis=0)
-            elif op == ReduceOp.SUM:
-                # dtype=: jnp.sum widens small ints (u8→u32); the wire
-                # contract returns the caller's dtype (reference preserves
-                # the MPI datatype end to end)
-                r = jnp.sum(g, axis=0, dtype=g.dtype)
-            elif op == ReduceOp.MIN:
-                r = jnp.min(g, axis=0)
-            elif op == ReduceOp.MAX:
-                r = jnp.max(g, axis=0)
-            elif op == ReduceOp.PRODUCT:
-                r = jnp.prod(g, axis=0, dtype=g.dtype)
-            elif op == ReduceOp.ADASUM:
-                from .adasum import adasum_tree_reduce
-
-                r = adasum_tree_reduce(g)
-            else:
-                raise ValueError(f"unsupported op {op}")
-            return r * postscale_factor if postscale_factor != 1.0 else r
-
-        if not hier:
-            return jax.jit(reduce_flat, out_shardings=_replicated(ps))
-
-        # Two-level path (HOROVOD_HIERARCHICAL_ALLREDUCE; reference
-        # NCCLHierarchicalAllreduce, nccl_operations.cc:188-370:
-        # ReduceScatter-intra → Allreduce-cross → Allgather-intra). Each
-        # local chip takes 1/nlocal of the row, psums it over the process
-        # axis (cross traffic / nlocal per chip), then the reduced shards
-        # are allgathered back over the intra-process (ICI) axis.
-        mesh = ps.mesh_2d
-        nl = mesh.shape[LOCAL_AXIS]
-
-        def per_chip(gl):  # gl: [1, ...] — this process's row
-            x0 = gl[0]
-            flat = x0.reshape(-1)
-            pad = (-flat.size) % nl
-            padded = jnp.pad(flat, (0, pad))
-            csz = padded.size // nl
-            li = lax.axis_index(LOCAL_AXIS)
-            chunk = lax.dynamic_slice(padded, (li * csz,), (csz,))
-            if prescale_factor != 1.0:
-                chunk = chunk * prescale_factor
-            if op == ReduceOp.ADASUM:
-                # two-level Adasum (reference adasum_gpu_operations.cc):
-                # each local chip already holds a 1/nl chunk of this
-                # process's contribution; the cross-process hypercube
-                # runs on chunks with dot/norm scalars psummed over the
-                # local axis, so coefficients describe the full vectors
-                # and the result EQUALS flat Adasum — with cross (DCN)
-                # traffic per chip divided by nl
-                from .adasum import adasum_allreduce
-
-                red = adasum_allreduce(chunk, PROC_AXIS,
-                                       norm_axis=LOCAL_AXIS)
-            else:
-                red = lax.psum(chunk, PROC_AXIS)
-                if op == ReduceOp.AVERAGE:
-                    red = red / ps.cross_size
-            if postscale_factor != 1.0:
-                red = red * postscale_factor
-            full = _traced_allgather(red[None], LOCAL_AXIS)
-            full = full.reshape(-1)[:flat.size]
-            return full.reshape(x0.shape)
-
-        def f(g):
-            return jax.shard_map(per_chip, mesh=mesh,
-                                 in_specs=P(PROC_AXIS),
-                                 out_specs=P(), check_vma=False)(g)
-
-        return jax.jit(f, out_shardings=_replicated(ps))
+        return jax.jit(
+            _allreduce_body(ps, op, prescale_factor, postscale_factor, hier),
+            out_shardings=_replicated(ps))
 
     g = _global_row_array(ps, xl)
     return _cached(key, build)(g)
+
+
+# ===========================================================================
+# Fused-chunk plans — steady-state replay of the whole pack→reduce→unpack
+# chain as ONE compiled program per chunk
+# ===========================================================================
+#
+# The cycle loop's legacy chunk dispatch pays N+2 eager dispatches per chunk
+# per cycle (per-tensor ravels, a concatenate, the reduce, the unpack) and
+# re-derives the chunk layout from scratch every step. A steady-state
+# training loop enqueues the *same* named tensors with the same shapes each
+# step — the same observation behind the reference's response cache
+# (response_cache.cc) — so the entire chain is cacheable. A plan is keyed by
+# the full chunk signature and holds at most two compiled programs:
+#
+# - ``run``: reduce + static-slice unpack fused into one program (for a
+#   single-process world it degenerates to scale + unpack, or per-tensor
+#   identity on the device path — still one dispatch).
+# - ``pack``: ravel+concat for device-resident inputs (the host path packs
+#   into a persistent staging buffer instead, see _native.FusionBuffer).
+#
+# Plans live in the same LRU as every other eager program so one
+# HOROVOD_CACHE_CAPACITY bounds total compiled-program memory.
+
+
+class FusedChunkPlan:
+    """Compiled steady-state replay for one fused-allreduce chunk."""
+
+    __slots__ = ("ps", "nproc", "on_device", "pack", "run")
+
+    def __init__(self, ps, nproc, on_device, pack, run):
+        self.ps = ps
+        self.nproc = nproc
+        self.on_device = on_device
+        self.pack = pack
+        self.run = run
+
+    def execute(self, inputs):
+        """Dispatch the chunk. ``inputs`` is the list of per-tensor device
+        arrays (device plan) or the packed flat host buffer (host plan).
+        Returns the list of per-tensor outputs.
+
+        Host staging uploads via EXPLICIT device_put (here for the
+        single-process case, inside _global_row_array otherwise) so user
+        code under ``jax.transfer_guard("disallow")`` can still issue
+        eager collectives — jit's implicit argument transfer would trip
+        the guard."""
+        if self.nproc == 1:
+            if self.on_device:
+                return self.run(*inputs)
+            return self.run(jax.device_put(inputs))
+        flat = self.pack(*inputs) if self.on_device else inputs
+        g = _global_row_array(self.ps, flat)
+        return self.run(g)
+
+
+def _build_fused_plan(ps, nproc, op, pre, post, sizes, shapes, on_device,
+                      hier):
+    def unpack(red):
+        parts = []
+        off = 0
+        for n, shape in zip(sizes, shapes):
+            parts.append(jnp.reshape(
+                lax.slice(red, (off,), (off + n,)), shape))
+            off += n
+        return parts
+
+    if nproc == 1:
+        scale = pre != 1.0 or post != 1.0
+        if on_device:
+            # single-process device chunk: no wire to cross, so skip the
+            # concat/split round-trip entirely — one per-tensor identity
+            # (or scale) program
+            def f(*arrs):
+                outs = [jnp.asarray(a) for a in arrs]
+                if scale:
+                    outs = [o * pre * post for o in outs]
+                return outs
+
+            return FusedChunkPlan(ps, nproc, on_device, None, jax.jit(f))
+
+        def f(flat):
+            out = flat * pre * post if scale else flat
+            return unpack(out)
+
+        return FusedChunkPlan(ps, nproc, on_device, None, jax.jit(f))
+
+    body = _allreduce_body(ps, op, pre, post, hier)
+
+    def run(g):
+        return unpack(body(g))
+
+    run_j = jax.jit(run, out_shardings=_replicated(ps))
+    pack_j = None
+    if on_device:
+        def pack(*arrs):
+            if len(arrs) == 1:
+                return jnp.ravel(arrs[0])
+            return jnp.concatenate([jnp.ravel(a) for a in arrs])
+
+        pack_j = jax.jit(pack)
+    return FusedChunkPlan(ps, nproc, on_device, pack_j, run_j)
+
+
+def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
+                     names, sizes, shapes, dtype, on_device: bool):
+    """Look up (or compile) the one-dispatch plan for a fused chunk.
+
+    Keyed by the full chunk signature — ordered names, shapes, dtype,
+    reduce op, scale factors, process set, residency, and the current
+    hierarchical verdict (recomputed here so an autotuner flip of the
+    hier flag naturally misses onto a fresh plan rather than replaying a
+    stale topology). Returns ``None`` for chunks no plan covers
+    (zero total elements — those route through the legacy path)."""
+    sizes = tuple(int(s) for s in sizes)
+    if sum(sizes) == 0:
+        return None
+    nproc = ps.cross_size
+    hier = nproc > 1 and _allreduce_hier(op, ps, nproc)
+    key = (_PLAN_KEY, "allreduce", ps.name, tuple(names), tuple(shapes),
+           str(dtype), int(op), float(prescale_factor),
+           float(postscale_factor), bool(on_device), hier)
+    m = _plan_metrics()
+    plan = _EAGER_CACHE.get(key)
+    if plan is not None:
+        _EAGER_CACHE.move_to_end(key)
+        m[0].inc()
+        return plan
+    m[1].inc()
+    plan = _build_fused_plan(ps, nproc, op, float(prescale_factor),
+                             float(postscale_factor), sizes, tuple(shapes),
+                             bool(on_device), hier)
+    global _plan_count
+    _EAGER_CACHE[key] = plan
+    _plan_count += 1
+    _evict_over_capacity()
+    m[4].set(_plan_count)
+    return plan
 
 
 def _eager_allgather(x, ps: ProcessSet):

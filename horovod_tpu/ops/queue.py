@@ -171,10 +171,17 @@ class BackgroundRuntime:
         self.queue = TensorQueue()
         self.handles = HandleManager()
         # fusion pack helper (reference fusion_buffer_manager.h:40);
-        # native batched-memcpy when the C++ core is built
+        # native batched-memcpy when the C++ core is built, staging into a
+        # persistent ring sized to the fusion threshold
         from .._native import FusionBuffer
 
-        self.fusion_buffer = FusionBuffer()
+        self.fusion_buffer = FusionBuffer(
+            config.fusion_threshold_bytes,
+            slots=getattr(config, "staging_ring_slots", 4))
+        # compiled fused-chunk plans (collectives.fused_chunk_plan) replay
+        # the whole pack→reduce→unpack chain as one program per chunk;
+        # HOROVOD_FUSED_PLAN_DISABLE falls back to the per-cycle eager chain
+        self._plans_enabled = not getattr(config, "fused_plan_disable", False)
         self._pending: dict[str, TensorEntry] = {}  # negotiated-path backlog
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -236,7 +243,7 @@ class BackgroundRuntime:
         receipt, so every rank switches knobs at the same round boundary
         relative to the collectives it executes."""
         try:
-            self.fusion_threshold = int(p["fusion"])
+            self.set_fusion_threshold(int(p["fusion"]))
             self.cycle_time_ms = float(p["cycle"])
             if "hier_ar" in p or "hier_ag" in p:
                 from ..common import context as ctx_mod
@@ -250,6 +257,21 @@ class BackgroundRuntime:
             at = self.autotuner
             if at is not None and p.get("final"):
                 at.done = True
+
+    def set_fusion_threshold(self, nbytes: int):
+        """Adopt a new fusion threshold. Chunk boundaries move, so the
+        staging ring is resized and every cached fused-chunk plan is
+        invalidated — their signatures can never be looked up again and
+        would otherwise crowd live programs out of the shared LRU."""
+        nbytes = int(nbytes)
+        if nbytes == self.fusion_threshold:
+            return
+        self.fusion_threshold = nbytes
+        try:
+            self.fusion_buffer.resize(nbytes)
+        except Exception:
+            LOG.exception("staging ring resize failed")
+        C.invalidate_fused_plans()
 
     def _maybe_controller(self):
         """Cross-process negotiation over the launcher's rendezvous store —
@@ -424,8 +446,14 @@ class BackgroundRuntime:
                 t = e.tensor
                 dtype = str(getattr(t, "dtype", None)
                             or np.asarray(t).dtype)
+                # key on the stable process-set NAME, not id(): id() of a
+                # GC-reclaimed dead set can be recycled for a new one and
+                # alias two different sets into one fused group. The name
+                # is registry-unique, and None (default set) folds into
+                # the runtime set it resolves to at dispatch.
+                ps = e.process_set or self.process_set
                 key = (dtype, int(e.reduce_op), e.prescale_factor,
-                       e.postscale_factor, id(e.process_set))
+                       e.postscale_factor, getattr(ps, "name", "global"))
                 fusable.setdefault(key, []).append(e)
             else:
                 singles.append(e)
@@ -585,43 +613,46 @@ class BackgroundRuntime:
                 for n in names:
                     self.timeline.start_activity(n, "FUSED_ALLREDUCE")
             try:
-                import jax.numpy as _jnp
-
-                # device-resident chunk: fuse on device (jnp.concatenate)
-                # instead of the host fusion buffer — gradients that
-                # already live in HBM never round-trip through the host
-                # (reference NCCL path reduces the GPU buffer in place)
+                # device-resident chunk: fuse on device instead of the
+                # host fusion buffer — gradients that already live in HBM
+                # never round-trip through the host (reference NCCL path
+                # reduces the GPU buffer in place)
                 on_dev = all(C.is_device_resident(e.tensor) for e in chunk)
                 if on_dev:
                     arrs = [e.tensor for e in chunk]
-                    flats = [_jnp.ravel(a) for a in arrs]
-                    fused = flats[0] if len(flats) == 1 \
-                        else _jnp.concatenate(flats)
                 else:
                     arrs = [np.asarray(e.tensor) for e in chunk]
-                    if len(arrs) > 1:
-                        fused = self.fusion_buffer.pack(arrs)
-                    else:
-                        fused = arrs[0].ravel()
                 e0 = chunk[0]
-                red = C._eager_allreduce(
-                    fused, e0.reduce_op, e0.process_set or self.process_set,
-                    e0.prescale_factor, e0.postscale_factor)
-                self.bytes_processed += fused.nbytes
-                m_bytes, m_lat, m_ops = self._op_metrics(
-                    "allreduce", str(fused.dtype))
-                m_bytes.inc(int(fused.nbytes))
+                ps = e0.process_set or self.process_set
+                sizes = tuple(int(a.size) for a in arrs)
+                shapes = tuple(tuple(a.shape) for a in arrs)
+                dtype = str(arrs[0].dtype)
+                total_bytes = sum(int(a.nbytes) for a in arrs)
+                # steady-state fast path: replay the compiled plan for this
+                # chunk signature — one program dispatch covering
+                # pack+reduce+unpack (falls back to the eager chain when
+                # disabled or for zero-element chunks)
+                plan = None
+                if self._plans_enabled:
+                    plan = C.fused_chunk_plan(
+                        ps, e0.reduce_op, e0.prescale_factor,
+                        e0.postscale_factor, tuple(names), sizes, shapes,
+                        dtype, on_dev)
+                if plan is not None:
+                    parts = self._dispatch_plan(plan, arrs, on_dev)
+                else:
+                    parts = self._dispatch_legacy(arrs, on_dev, e0, ps,
+                                                  sizes, shapes)
+                self.bytes_processed += total_bytes
+                m_bytes, m_lat, m_ops = self._op_metrics("allreduce", dtype)
+                m_bytes.inc(total_bytes)
                 m_ops.inc()
                 m_lat.observe(time.perf_counter() - t0)
                 self._m_fusion_batch.observe(len(chunk))
-                self._m_fused_bytes.observe(int(fused.nbytes))
-                # results stay device-side lazy slices: the cycle thread
+                self._m_fused_bytes.observe(total_bytes)
+                # results stay device-side lazy values: the cycle thread
                 # must not block on completion (async contract; callers
-                # observe readiness per-handle). Jitted unpack: no scalar
-                # offset staging (see collectives.unpack_flat).
-                parts = C.unpack_flat(
-                    red, tuple(int(a.size) for a in arrs),
-                    tuple(tuple(a.shape) for a in arrs))
+                # observe readiness per-handle)
                 for e, p in zip(chunk, parts):
                     self._finish(e, p)
             except Exception as exc:  # fail the whole chunk
@@ -633,6 +664,47 @@ class BackgroundRuntime:
                 if self.timeline:
                     for n in names:
                         self.timeline.end_activity(n)
+
+    def _dispatch_plan(self, plan, arrs, on_dev):
+        """One-dispatch chunk execution. Host chunks stage through a leased
+        ring slot; the lease is retired with one of the plan's outputs as
+        completion token, so the slot frees exactly when the compiled
+        program has consumed the staged bytes (never earlier — the async
+        transfer, or a CPU-backend zero-copy alias, may still be reading)."""
+        if on_dev:
+            return plan.execute(arrs)
+        flat, lease = self.fusion_buffer.pack_leased(arrs)
+        try:
+            parts = plan.execute(flat)
+        except Exception:
+            # failed dispatch: results are discarded, so an immediate free
+            # cannot corrupt anything a caller will observe
+            if lease is not None:
+                lease.retire(None)
+            raise
+        if lease is not None:
+            lease.retire(parts[0])
+        return parts
+
+    def _dispatch_legacy(self, arrs, on_dev, e0, ps, sizes, shapes):
+        """Pre-plan eager chain (kept as the HOROVOD_FUSED_PLAN_DISABLE
+        fallback and for zero-element chunks): per-tensor ravels + concat
+        (device) or fresh-buffer pack (host), a cached reduce program, and
+        a separate jitted unpack dispatch (collectives.unpack_flat)."""
+        import jax.numpy as _jnp
+
+        if on_dev:
+            flats = [_jnp.ravel(a) for a in arrs]
+            fused = flats[0] if len(flats) == 1 \
+                else _jnp.concatenate(flats)
+        else:
+            if len(arrs) > 1:
+                fused = self.fusion_buffer.pack(arrs)
+            else:
+                fused = arrs[0].ravel()
+        red = C._eager_allreduce(fused, e0.reduce_op, ps,
+                                 e0.prescale_factor, e0.postscale_factor)
+        return C.unpack_flat(red, sizes, shapes)
 
     def _run_single(self, e: TensorEntry):
         t0 = time.perf_counter()

@@ -234,7 +234,14 @@ class Autotuner:
         if ctl is not None:
             ctl.submit_params(params)
             return
-        self.runtime.fusion_threshold = params["fusion"]
+        # through the runtime's setter when it has one (resizes the staging
+        # ring and invalidates fused-chunk plans whose boundaries moved);
+        # plain attribute set keeps duck-typed runtimes working
+        setter = getattr(self.runtime, "set_fusion_threshold", None)
+        if setter is not None:
+            setter(params["fusion"])
+        else:
+            self.runtime.fusion_threshold = params["fusion"]
         self.runtime.cycle_time_ms = params["cycle"]
         ps = getattr(self.runtime, "process_set", None)
         if ps is None or ps.cross_size == 1:
