@@ -409,6 +409,25 @@ def main():
     extras["allreduce_gbps_semantics"] = (
         "wire bytes (hvd_allreduce_bytes_total delta / wall time); the "
         "compressed config therefore reports post-compression bytes")
+    # per-span lifecycle summary when HOROVOD_TRACE is on (docs/timeline.md):
+    # where did the eager sub-benchmarks' collectives spend their time, and
+    # did the coordinator attribute any straggling?
+    trep = hvd.trace_report()
+    if trep.get("enabled"):
+        ph = trep.get("phases", {})
+
+        def _pct(phase, k):
+            d = ph.get(phase) or {}
+            return d.get(k)
+
+        extras["trace_negotiate_p50_ms"] = _pct("negotiate", "p50_ms")
+        extras["trace_negotiate_p95_ms"] = _pct("negotiate", "p95_ms")
+        extras["trace_dispatch_p50_ms"] = _pct("dispatch", "p50_ms")
+        extras["trace_dispatch_p95_ms"] = _pct("dispatch", "p95_ms")
+        extras["trace_spans"] = trep.get("spans")
+        strag = trep.get("straggler")
+        if strag:
+            extras["trace_straggler"] = strag
     if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
         # honest metadata: this run is the forced-CPU fallback because the
         # TPU child failed/hung (wedged tunnel) — numbers are NOT chip

@@ -100,6 +100,18 @@ def metrics_snapshot() -> dict:
     return _metrics.get_registry().snapshot()
 
 
+def trace_report() -> dict:
+    """Summary of this rank's collective-lifecycle spans (utils/tracing.py):
+    per-phase p50/p95 latencies (queue/negotiate/fuse/dispatch/total),
+    span and error counts, open spans, and straggler attribution when the
+    coordinator computed any. ``{"enabled": False}`` unless HOROVOD_TRACE
+    was set at init. The merged cross-rank view is ``GET /timeline`` on
+    the launcher's rendezvous server (docs/timeline.md)."""
+    from .utils import tracing as _tracing
+
+    return _tracing.report()
+
+
 # ---------------------------------------------------------------------------
 # Async handle-based API (reference torch/mpi_ops.py:843-879: *_async, poll,
 # synchronize, wait_and_clear)

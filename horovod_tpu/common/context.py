@@ -268,6 +268,16 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
         _ctx.process_sets = {"global": _ctx.global_set}
         _ctx.joined = False
 
+        if _ctx.config.trace_enabled:
+            # before the runtime/controller construct: both resolve the
+            # tracer once at build time (zero-cost None when off)
+            from ..utils import tracing as tracing_mod
+
+            tracing_mod.init_tracer(
+                rank=_ctx.global_set.cross_rank,
+                addr=os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR),
+                port=os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT))
+
         from ..utils.timeline import Timeline
 
         # the reference's timeline is recorded by the coordinator only

@@ -62,6 +62,13 @@ HOROVOD_ELASTIC_RESPAWN_BACKOFF = "HOROVOD_ELASTIC_RESPAWN_BACKOFF"
 HOROVOD_STAGING_RING_SLOTS = "HOROVOD_STAGING_RING_SLOTS"
 HOROVOD_FUSED_PLAN_DISABLE = "HOROVOD_FUSED_PLAN_DISABLE"
 HOROVOD_BACKEND_PROBE_TIMEOUT = "HOROVOD_BACKEND_PROBE_TIMEOUT"
+# cross-rank distributed tracing (utils/tracing.py; docs/timeline.md):
+# master switch, buffered-span cap per rank, and a clock-offset override
+# (seconds this rank's clock must be shifted to match the rendezvous
+# coordinator's) replacing the NTP-style /clock estimation
+HOROVOD_TRACE = "HOROVOD_TRACE"
+HOROVOD_TRACE_BUFFER = "HOROVOD_TRACE_BUFFER"
+HOROVOD_TRACE_CLOCK_OFFSET = "HOROVOD_TRACE_CLOCK_OFFSET"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -155,6 +162,10 @@ class RuntimeConfig:
     # the fused-plan escape hatch (legacy per-cycle eager dispatch)
     staging_ring_slots: int = 4
     fused_plan_disable: bool = False
+    # cross-rank tracing (utils/tracing.py): spans, merged /timeline,
+    # straggler attribution — off by default (zero-cost contract)
+    trace_enabled: bool = False
+    trace_buffer: int = 4096
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -191,4 +202,6 @@ class RuntimeConfig:
         c.staging_ring_slots = get_int(HOROVOD_STAGING_RING_SLOTS,
                                        c.staging_ring_slots)
         c.fused_plan_disable = get_bool(HOROVOD_FUSED_PLAN_DISABLE)
+        c.trace_enabled = get_bool(HOROVOD_TRACE)
+        c.trace_buffer = get_int(HOROVOD_TRACE_BUFFER, c.trace_buffer)
         return c

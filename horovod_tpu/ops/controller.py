@@ -59,6 +59,7 @@ from typing import Optional
 from ..utils import faults as faults_mod
 from ..utils import metrics as metrics_mod
 from ..utils import retry as retry_mod
+from ..utils import tracing as tracing_mod
 
 LOG = logging.getLogger("horovod_tpu")
 
@@ -185,6 +186,11 @@ class KVController:
         self._m_wire_bytes = reg.counter(
             "hvd_controller_wire_bytes_total",
             "negotiation submission bytes put to the KV store")
+        # cross-rank tracing: when on, each submission carries this rank's
+        # clock-aligned submit time so the coordinator can attribute
+        # stragglers; when off, the wire format is byte-identical to the
+        # untraced build (zero-cost contract)
+        self._tracer = tracing_mod.get_tracer()
         self._coord: Optional[_Coordinator] = None
         if rank == 0:
             self._coord = _Coordinator(client, size,
@@ -210,15 +216,30 @@ class KVController:
             raise RuntimeError("controller is broken; re-initialize horovod_tpu")
         r = self.round
         try:
+            # the base payload (no timestamp) is what the SAME_AS_LAST
+            # comparison sees: a per-round submit time must not break the
+            # 1-byte steady-state fast path
             payload = json.dumps(
                 {"e": [[n, sig] for n, sig in pending.items()],
                  "j": bool(joined), "sd": bool(shutting_down)}).encode()
+            t_sub = (self._tracer.aligned_now()
+                     if self._tracer is not None and pending else None)
             if payload == self._last_payload:
+                # fast round; with tracing on, the marker carries a tiny
+                # timestamp suffix the coordinator strips (still O(1) and
+                # signature-free — the cached submission decodes the set)
                 wire = self.SAME_AS_LAST
+                if t_sub is not None:
+                    wire += json.dumps({"t": t_sub}).encode()
                 self.fast_rounds += 1
                 self._m_cache_hit.inc()
             else:
                 wire = payload
+                if t_sub is not None:
+                    wire = json.dumps(
+                        {"e": [[n, sig] for n, sig in pending.items()],
+                         "j": bool(joined), "sd": bool(shutting_down),
+                         "t": t_sub}).encode()
                 self._m_cache_miss.inc()
             faults_mod.fault_point("controller.submit")
             self.client.put(_ctl_scope(r), f"ready/{self.rank}", wire)
@@ -355,6 +376,12 @@ class _Coordinator(threading.Thread):
         # name -> first time it entered the table (stall attribution)
         self._first_seen: dict[str, float] = {}
         self._stall_warned: set[str] = set()
+        # tracing: per-tensor, per-rank first clock-aligned submit times;
+        # straggler metrics are created lazily on first attribution so an
+        # untraced run exposes no hvd_straggler_* series at all
+        self._arrivals: dict[str, dict[int, float]] = {}
+        self._m_strag_wait = None
+        self._m_strag_last: dict[int, object] = {}
         self.stall_warnings = 0  # observability for tests
         reg = metrics_mod.get_registry()
         self._m_responses = reg.counter(
@@ -412,6 +439,7 @@ class _Coordinator(threading.Thread):
         # SAME_AS_LAST decode cache is stale on both sides: drop it here
         # and tell workers to resend full payloads next round
         self._last_submission.clear()
+        self._arrivals.clear()
         self.client.put(_ctl_scope(r), "resp",
                         json.dumps({"ready": [], "errors": errors,
                                     "invalidate": True}).encode())
@@ -489,12 +517,26 @@ class _Coordinator(threading.Thread):
                     continue
                 for k in sorted(got):
                     raw = got[k]
-                    if raw == KVController.SAME_AS_LAST:
+                    t_sub = None
+                    if raw[:1] == KVController.SAME_AS_LAST:
                         msg = self._last_submission.get(k, {"e": [], "j": False})
+                        if len(raw) > 1:
+                            # tracing: marker + {"t": submit_time} suffix —
+                            # the cached submission still decodes the set
+                            try:
+                                t_sub = float(json.loads(raw[1:])["t"])
+                            except (ValueError, TypeError, KeyError):
+                                t_sub = None
                     else:
                         msg = json.loads(raw)
                         if isinstance(msg, list):  # tolerate bare entry lists
                             msg = {"e": msg, "j": False}
+                        t = msg.pop("t", None)  # per-round, not part of the
+                        if t is not None:       # cached submission set
+                            try:
+                                t_sub = float(t)
+                            except (TypeError, ValueError):
+                                t_sub = None
                         self._last_submission[k] = msg
                     if msg.get("j") and k not in self._joined:
                         self._joined.add(k)
@@ -502,7 +544,7 @@ class _Coordinator(threading.Thread):
                     if msg.get("sd"):
                         self._down.add(k)
                     for name, sig in msg.get("e", []):
-                        self._increment(name, sig, k)
+                        self._increment(name, sig, k, t_sub)
                 self._check_stalled_tensors()
                 # A tensor is ready when every rank either submitted it or
                 # has joined (joined ranks are implicit zero contributors,
@@ -520,6 +562,7 @@ class _Coordinator(threading.Thread):
                         k["j"] = False
                 errors = {n: self.errors[n] for n in list(self.errors)}
                 sigs = {n: self.table[n][0] for n in ready}
+                strag = self._attribute_stragglers(ready)
                 for n in ready:
                     del self.table[n]
                     self.order.remove(n)
@@ -532,8 +575,11 @@ class _Coordinator(threading.Thread):
                     self.errors.pop(n, None)
                     self._first_seen.pop(n, None)
                     self._stall_warned.discard(n)
+                    self._arrivals.pop(n, None)
                 resp_dict = {"ready": ready, "sigs": sigs,
                              "errors": errors, "join_done": join_done}
+                if strag:
+                    resp_dict["strag"] = strag
                 if len(self._down) == self.size:
                     # reference: shutdown only when every rank requested
                     # it (operations.cc:728 horovod_shutdown semantics)
@@ -616,11 +662,19 @@ class _Coordinator(threading.Thread):
                 self.stall_warnings += 1
                 self._m_stall_warn.inc()
 
-    def _increment(self, name: str, sig: list, rank: int):
+    def _increment(self, name: str, sig: list, rank: int,
+                   t_sub: Optional[float] = None):
         """IncrementTensorCount + mismatch validation (controller.cc:942,
-        :471-748)."""
+        :471-748). ``t_sub`` is the submitting rank's clock-aligned submit
+        time (tracing on): the *first* one per (tensor, rank) is kept —
+        re-submissions across rounds are the same pending op, and the
+        coordinator's own gather blocks until every rank reported, so
+        worker-reported times are the only per-rank arrival signal with
+        sub-round resolution."""
         import time as _time
 
+        if t_sub is not None:
+            self._arrivals.setdefault(name, {}).setdefault(rank, t_sub)
         if name not in self.table:
             self.table[name] = (sig, {rank})
             self.order.append(name)
@@ -634,6 +688,42 @@ class _Coordinator(threading.Thread):
                 "controller.cc:538-619 semantics)")
             return
         ranks.add(rank)
+
+    def _attribute_stragglers(self, ready: list[str]) -> dict:
+        """Per released tensor: which rank's submit was last and how long
+        the fastest submitter waited (critical-path attribution). Only
+        when every required rank reported a submit time — a partial set
+        would misattribute. Feeds hvd_straggler_* metrics and rides the
+        response so every rank stamps its spans identically."""
+        strag: dict[str, list] = {}
+        for n in ready:
+            arr = self._arrivals.pop(n, None)
+            if not arr or len(arr) < 2:
+                continue
+            required = self._required(n) - self._joined
+            if not required.issubset(arr.keys()):
+                continue
+            times = {k: arr[k] for k in required}
+            last = max(times, key=lambda k: times[k])
+            wait = max(times.values()) - min(times.values())
+            strag[n] = [last, round(wait, 6)]
+            if self._m_strag_wait is None:
+                reg = metrics_mod.get_registry()
+                self._m_strag_wait = reg.histogram(
+                    "hvd_straggler_wait_seconds",
+                    "per-collective wait between the fastest and the "
+                    "last-submitting rank (clock-aligned)",
+                    buckets=tracing_mod.STRAGGLER_BUCKETS_S)
+            self._m_strag_wait.observe(wait)
+            c = self._m_strag_last.get(last)
+            if c is None:
+                c = self._m_strag_last[last] = \
+                    metrics_mod.get_registry().counter(
+                        "hvd_straggler_last_rank_total",
+                        "collectives for which this rank submitted last",
+                        rank=str(last))
+            c.inc()
+        return strag
 
     def stop(self):
         self._stop_evt.set()

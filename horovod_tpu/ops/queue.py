@@ -45,6 +45,7 @@ import numpy as np
 
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
 from ..utils import metrics as metrics_mod
+from ..utils import tracing as tracing_mod
 from . import collectives as C
 
 LOG = logging.getLogger("horovod_tpu")
@@ -65,6 +66,8 @@ class TensorEntry:
     process_set: Any = None
     handle: int = -1
     enqueue_time: float = field(default_factory=time.monotonic)
+    # lifecycle trace span (utils/tracing.py); None unless HOROVOD_TRACE
+    span: Any = None
 
 
 class HandleManager:
@@ -228,6 +231,10 @@ class BackgroundRuntime:
         self.joined = False
         self._join_done_evt = threading.Event()
         self._join_last_rank = -1
+        # cross-rank tracing: resolved once; None keeps every span hook a
+        # single ``is not None`` check (the zero-cost contract enforced by
+        # benchmarks/trace_overhead.py)
+        self.tracer = tracing_mod.get_tracer()
         self.controller = self._maybe_controller()
         if self.controller is not None:
             self.controller.on_params = self._apply_tuned_params
@@ -340,7 +347,17 @@ class BackgroundRuntime:
             self.stall.record_pending(entry.name)
         if self.timeline:
             self.timeline.negotiate_start(entry.name, entry.op.upper())
-        self.queue.push(entry)
+        if self.tracer is None:
+            self.queue.push(entry)
+        else:
+            entry.span = self.tracer.begin(entry.name, entry.op)
+            try:
+                self.queue.push(entry)
+            except BaseException:
+                # rejected entries (duplicate name, shut-down queue) never
+                # reach _finish — close the span here or it leaks open
+                self.tracer.finish(entry.span, error=True)
+                raise
         self._wake.set()
         return entry.handle
 
@@ -371,6 +388,9 @@ class BackgroundRuntime:
                 self.controller.drain_shutdown()
             self.controller.stop()
         for e in list(self._pending.values()) + self.queue.finalize():
+            if e.span is not None and self.tracer is not None:
+                self.tracer.finish(e.span, error=True)
+                e.span = None
             self.handles.mark_done(
                 e.handle, exc=HorovodInternalError("Horovod has been shut down"))
         self._pending.clear()
@@ -393,6 +413,11 @@ class BackgroundRuntime:
         cycle_t0 = time.perf_counter()
         if batch:
             self._m_queue_depth.set(len(batch))
+            if self.tracer is not None:
+                now = time.time()
+                for e in batch:
+                    if e.span is not None:
+                        e.span.t[tracing_mod.T_DRAIN] = now
         # mark only working cycles: an idle 1 kHz loop would flood the trace
         # with meaningless CYCLE_START instants
         if self.timeline and batch:
@@ -484,6 +509,16 @@ class BackgroundRuntime:
         for e in batch:
             self._pending[self._wire_name(e)] = e
         sigs = {n: entry_signature(e) for n, e in self._pending.items()}
+        rnd = self.controller.round
+        if self.tracer is not None and self._pending:
+            now = time.time()
+            for e in self._pending.values():
+                # first round only: a tensor pending across rounds keeps
+                # the timestamp of the round that first carried it
+                if e.span is not None \
+                        and e.span.t[tracing_mod.T_NEG_START] is None:
+                    e.span.t[tracing_mod.T_NEG_START] = now
+                    e.span.round = rnd
         try:
             resp = self.controller.negotiate(sigs, joined=self.joined)
             ready, errors = resp["ready"], resp["errors"]
@@ -506,9 +541,21 @@ class BackgroundRuntime:
                 self._m_neg_errors.inc()
                 self._finish(e, None, HorovodInternalError(msg))
         out = []
+        strag = resp.get("strag") or {}
+        neg_end = time.time() if self.tracer is not None else 0.0
         for n in ready:
             if n in self._pending:
-                out.append(self._pending.pop(n))
+                e = self._pending.pop(n)
+                if e.span is not None:
+                    e.span.t[tracing_mod.T_NEG_END] = neg_end
+                    info = strag.get(n)
+                    if info:
+                        e.span.straggler_rank = int(info[0])
+                        e.span.straggler_wait_s = float(info[1])
+                        if self.stall:
+                            self.stall.note_straggler(
+                                e.name, int(info[0]), float(info[1]))
+                out.append(e)
             elif self.joined:
                 # fabricate a zero contribution from the coordinator's
                 # signature (reference: joined ranks contribute zeros,
@@ -585,6 +632,11 @@ class BackgroundRuntime:
             self.stall.record_done(entry.name)
         if self.timeline:
             self.timeline.negotiate_end(entry.name)
+        if entry.span is not None and self.tracer is not None:
+            # the single terminal: every execution/negotiation/stall/
+            # shutdown path converges here, so spans cannot leak open
+            self.tracer.finish(entry.span, error=exc is not None)
+            entry.span = None
         self.handles.mark_done(entry.handle, result, exc)
 
     def _run_fused_allreduce(self, group: list[TensorEntry]):
@@ -638,11 +690,23 @@ class BackgroundRuntime:
                         ps, e0.reduce_op, e0.prescale_factor,
                         e0.postscale_factor, tuple(names), sizes, shapes,
                         dtype, on_dev)
+                if self.tracer is not None:
+                    disp0 = time.time()
+                    for e in chunk:
+                        if e.span is not None:
+                            e.span.t[tracing_mod.T_DISPATCH_START] = disp0
+                            e.span.chunk_bytes = total_bytes
+                            e.span.chunk_tensors = len(chunk)
                 if plan is not None:
                     parts = self._dispatch_plan(plan, arrs, on_dev)
                 else:
                     parts = self._dispatch_legacy(arrs, on_dev, e0, ps,
                                                   sizes, shapes)
+                if self.tracer is not None:
+                    disp1 = time.time()
+                    for e in chunk:
+                        if e.span is not None:
+                            e.span.t[tracing_mod.T_DISPATCH_END] = disp1
                 self.bytes_processed += total_bytes
                 m_bytes, m_lat, m_ops = self._op_metrics("allreduce", dtype)
                 m_bytes.inc(total_bytes)
@@ -710,6 +774,8 @@ class BackgroundRuntime:
         t0 = time.perf_counter()
         if self.timeline:
             self.timeline.start_activity(e.name, e.op.upper())
+        if e.span is not None:
+            e.span.t[tracing_mod.T_DISPATCH_START] = time.time()
         try:
             ps = e.process_set or self.process_set
             if e.op == "allreduce":
@@ -735,6 +801,8 @@ class BackgroundRuntime:
             m_bytes.inc(int(nbytes))
             m_ops.inc()
             m_lat.observe(time.perf_counter() - t0)
+            if e.span is not None:
+                e.span.t[tracing_mod.T_DISPATCH_END] = time.time()
             self._finish(e, r)
         except Exception as exc:
             self._m_op_errors.inc()

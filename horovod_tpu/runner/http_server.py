@@ -95,6 +95,10 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = self._key()
         if key == "metrics":
             return self._do_metrics()
+        if key == "clock":
+            return self._do_clock()
+        if key == "timeline":
+            return self._do_timeline()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -150,6 +154,57 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type",
                          "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_clock(self):
+        """``GET /clock``: this server's wall clock, the common timebase
+        for cross-rank trace alignment (utils/tracing.py probes it a few
+        times at init, NTP-style: offset = server_t - midpoint of the
+        round trip). Auth-exempt like ``/metrics`` — a timestamp is not a
+        secret, and the probe must work before workers finish their
+        signed-store setup. Same no-collision argument: bare path, no
+        slash."""
+        import json
+
+        body = json.dumps({"t": time.time()}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_timeline(self):
+        """``GET /timeline``: one clock-aligned Chrome-trace JSON merging
+        every span buffer workers pushed under the ``trace/`` KV scope
+        (plus this process's own tracer, when it has one) — open the
+        response in chrome://tracing or Perfetto. Auth-exempt read-only
+        telemetry, same rationale as ``/metrics``."""
+        import json
+
+        from ..utils import tracing as tracing_mod
+
+        store = self.server.store  # type: ignore[attr-defined]
+        scope_prefix = tracing_mod.KV_SCOPE + "/"
+        with store.cond:
+            pushed = {k: v for k, v in store.data.items()
+                      if k.startswith(scope_prefix)}
+        buffers = []
+        local = tracing_mod.get_tracer()
+        if local is not None:
+            buffers.append(local.snapshot())
+        for k, v in sorted(pushed.items()):
+            try:
+                buf = json.loads(v)
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next scrape catches up
+            if local is not None and buf.get("rank") == local.rank:
+                continue  # local tracer is this rank's fresher view
+            buffers.append(buf)
+        body = json.dumps(tracing_mod.merge_chrome_trace(buffers)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

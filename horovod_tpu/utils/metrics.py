@@ -382,6 +382,19 @@ class MetricsDumper:
                                    payload)
             except Exception as e:
                 LOG.debug("metrics KV push failed: %s", e)
+            # trace push rides the same cadence: the launcher's
+            # GET /timeline merges one buffer per rank (last write wins;
+            # spans carry stable (name, round) ids)
+            try:
+                from . import tracing as tracing_mod
+
+                tracer = tracing_mod.get_tracer()
+                if tracer is not None:
+                    self.kv_client.put(
+                        tracing_mod.KV_SCOPE, f"rank{self.rank}",
+                        json.dumps(tracer.snapshot()).encode())
+            except Exception as e:
+                LOG.debug("trace KV push failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import time
+from typing import Optional
 
 from ..common.exceptions import StalledTensorError
 from . import metrics as metrics_mod
@@ -46,6 +47,11 @@ class StallInspector:
         self.disabled = disabled
         self._pending: dict[str, float] = {}
         self._warned: set[str] = set()
+        # most recent straggler attribution from the coordinator (tracing
+        # on): (rank, tensor name, wait_s, monotonic time). A stall
+        # warning that can name the suspect rank beats one that can only
+        # name the stuck tensor.
+        self._last_straggler: Optional[tuple] = None
         reg = metrics_mod.get_registry()
         self._m_oldest = reg.gauge(
             "hvd_stall_oldest_pending_age_seconds",
@@ -68,6 +74,25 @@ class StallInspector:
         self._pending.pop(name, None)
         self._warned.discard(name)
 
+    def note_straggler(self, name: str, rank: int, wait_s: float):
+        """Record the coordinator's latest straggler attribution (fed by
+        the negotiation response when tracing is on)."""
+        self._last_straggler = (rank, name, wait_s, time.monotonic())
+
+    # attribution staler than this is history, not a lead on the current
+    # stall — keep it out of the warning text
+    STRAGGLER_FRESH_S = 300.0
+
+    def _suspect(self) -> str:
+        if self._last_straggler is None:
+            return ""
+        rank, name, wait_s, t = self._last_straggler
+        if time.monotonic() - t > self.STRAGGLER_FRESH_S:
+            return ""
+        return (f" Straggler attribution: rank {rank} was last to submit "
+                f"{name!r} (peers waited {wait_s:.3f} s); suspect that "
+                "rank first.")
+
     def check(self):
         """Called once per background cycle (reference: invoked from
         ComputeResponseList, controller.cc:294)."""
@@ -84,13 +109,14 @@ class StallInspector:
         stalled = [(n, now - t) for n, t in self._pending.items()
                    if now - t > self.warning_time_s]
         dist = _age_distribution(ages) if stalled else ""
+        suspect = self._suspect() if stalled else ""
         for name, age in stalled:
             if name not in self._warned:
                 LOG.warning(
                     "Tensor %s has been pending for %.0f s without executing. "
                     "This may indicate that not all processes are submitting "
-                    "the same collectives in the same order. Queue: %s.",
-                    name, age, dist)
+                    "the same collectives in the same order. Queue: %s.%s",
+                    name, age, dist, suspect)
                 self._warned.add(name)
                 self._m_warnings.inc()
                 self._m_stalled.inc()

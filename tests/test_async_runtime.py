@@ -133,6 +133,90 @@ def test_timeline_simplequeue_fallback(tmp_path, monkeypatch):
             assert "ph" in ev and "pid" in ev
 
 
+def _emit_sequence(tl, n=5, prefix="grad"):
+    """One deterministic emission sequence, reusable across transports."""
+    for i in range(n):
+        tl.negotiate_start(f"{prefix}/{i}", "ALLREDUCE")
+        tl.negotiate_end(f"{prefix}/{i}")
+        tl.start_activity(f"{prefix}/{i}", "QUEUED")
+        tl.end_activity(f"{prefix}/{i}")
+
+
+def test_timeline_reopen_mid_drain(tmp_path):
+    """reopen() while the writer is still draining a burst: the implicit
+    close() must flush every queued event into the FIRST file before the
+    second opens — both files end up valid, complete Chrome-trace JSON
+    (reference operations.cc:738-764 runtime timeline start/stop)."""
+    import json
+
+    from horovod_tpu.utils.timeline import Timeline
+
+    f1, f2 = tmp_path / "first.json", tmp_path / "second.json"
+    tl = Timeline(str(f1), mark_cycles=False)
+    _emit_sequence(tl, n=50, prefix="first")
+    tl.reopen(str(f2), mark_cycles=True)  # immediately: drain in flight
+    assert tl.enabled
+    _emit_sequence(tl, n=5, prefix="second")
+    tl.mark_cycle_start()
+    tl.close()
+
+    ev1 = [e for e in json.loads(f1.read_text()) if e]
+    # 50 M (one per lane) + 50 B + 50 E pairs x2 activities: none dropped
+    assert sum(1 for e in ev1 if e.get("ph") == "B") == 100
+    assert sum(1 for e in ev1 if e.get("ph") == "E") == 100
+    assert {e["args"]["name"] for e in ev1 if e.get("ph") == "M"} \
+        == {f"first/{i}" for i in range(50)}
+    ev2 = [e for e in json.loads(f2.read_text()) if e]
+    assert sum(1 for e in ev2 if e.get("ph") == "B") == 10
+    assert any(e.get("ph") == "i" for e in ev2)  # mark_cycles honored
+    assert not any("first/" in str(e) for e in ev2)  # no cross-file bleed
+
+
+def test_timeline_close_flushes_queued_fallback(tmp_path, monkeypatch):
+    """SimpleQueue fallback: a close() racing a large queued backlog must
+    write every event before the closer (the None-sentinel drain path)."""
+    import json
+
+    import horovod_tpu._native as native_mod
+    from horovod_tpu.utils.timeline import Timeline
+
+    monkeypatch.setattr(native_mod, "lib", lambda: None)
+    f = tmp_path / "flush.json"
+    tl = Timeline(str(f))
+    assert tl._native is None
+    _emit_sequence(tl, n=100, prefix="flush")
+    tl.close()  # no sleep: everything still queued is close()'s problem
+    ev = [e for e in json.loads(f.read_text()) if e]
+    assert sum(1 for e in ev if e.get("ph") == "B") == 200
+    assert sum(1 for e in ev if e.get("ph") == "E") == 200
+
+
+def test_timeline_native_and_fallback_identical_json(tmp_path, monkeypatch):
+    """The transport is an implementation detail: the native SPSC ring
+    and the SimpleQueue fallback must serialize the same emission
+    sequence to identical JSON (timestamps aside)."""
+    import json
+
+    import horovod_tpu._native as native_mod
+    from horovod_tpu.utils.timeline import Timeline
+
+    if native_mod.lib() is None:
+        pytest.skip("native core unavailable: nothing to compare against")
+
+    def run(path):
+        tl = Timeline(str(path), mark_cycles=True)
+        _emit_sequence(tl, n=7)
+        tl.mark_cycle_start()
+        tl.close()
+        return [{k: v for k, v in e.items() if k != "ts"}
+                for e in json.loads(path.read_text()) if e]
+
+    native_ev = run(tmp_path / "native.json")
+    monkeypatch.setattr(native_mod, "lib", lambda: None)
+    fallback_ev = run(tmp_path / "fallback.json")
+    assert native_ev == fallback_ev
+
+
 def test_async_fused_allreduce_device_resident_no_host_copy():
     """Device-resident jax.Array gradients through the ASYNC queue fuse on
     device (jnp.concatenate), never the host fusion buffer (reference NCCL
