@@ -20,21 +20,45 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "core.cc")
 _SO = os.path.join(_HERE, "libhvdcore.so")
 _lock = threading.Lock()
-_lib = None
-_tried = False
+# .so path -> loaded CDLL (or None after a failed attempt). Keyed by path
+# because sanitized builds live under their own filenames — a TSan .so
+# must never be mtime-fresh enough to serve a later normal-mode run.
+_libs: dict = {}
+
+_SANITIZERS = ("address", "thread")
 
 
-def _build() -> bool:
+def _sanitize_mode() -> str:
+    """Validated HOROVOD_NATIVE_SANITIZE value ("" when unset/invalid)."""
+    from ..common import env as env_schema
+
+    v = os.environ.get(env_schema.HOROVOD_NATIVE_SANITIZE, "").strip().lower()
+    if v and v not in _SANITIZERS:
+        LOG.warning("ignoring HOROVOD_NATIVE_SANITIZE=%r (expected one of %s)",
+                    v, "|".join(_SANITIZERS))
+        return ""
+    return v
+
+
+def _so_path(mode: str) -> str:
+    if not mode:
+        return _SO
+    return os.path.join(_HERE, f"libhvdcore-{mode[0]}san.so")
+
+
+def _build(so: str, mode: str) -> bool:
     # N launcher workers on one host all build on first use; the shared
     # atomic-replace helper keeps concurrent g++ runs from truncating
     # each other's output (0o777: .so keeps exec bits under the umask)
     from ..common.util import atomic_tmp
 
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    if mode:
+        cmd += [f"-fsanitize={mode}", "-g", "-fno-omit-frame-pointer"]
     try:
-        with atomic_tmp(_SO, mode=0o777) as tmp:
+        with atomic_tmp(so, mode=0o777) as tmp:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-o", tmp, _SRC, "-lpthread"],
+                cmd + ["-o", tmp, _SRC, "-lpthread"],
                 check=True, capture_output=True, timeout=120)
         return True
     except Exception as e:
@@ -43,23 +67,32 @@ def _build() -> bool:
 
 
 def lib():
-    """Load (building if needed) the native core; None on any failure."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
+    """Load (building if needed) the native core; None on any failure.
+
+    ``HOROVOD_NATIVE_SANITIZE=address|thread`` builds/loads an
+    instrumented variant instead (loading the ASan variant additionally
+    requires libasan in LD_PRELOAD when the interpreter itself is not
+    sanitized — see tests/test_native_sanitize.py)."""
+    from ..common import env as env_schema
+
+    mode = _sanitize_mode()
+    so = _so_path(mode)
+    if so in _libs:
+        return _libs[so]
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if os.environ.get("HOROVOD_TPU_DISABLE_NATIVE", "") in ("1", "true"):
+        if so in _libs:
+            return _libs[so]
+        _libs[so] = None
+        if os.environ.get(env_schema.HOROVOD_TPU_DISABLE_NATIVE,
+                          "") in ("1", "true"):
             return None
-        if not os.path.exists(_SO) or (
+        if not os.path.exists(so) or (
                 os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not _build():
+                and os.path.getmtime(_SRC) > os.path.getmtime(so)):
+            if not _build(so, mode):
                 return None
         try:
-            L = ctypes.CDLL(_SO)
+            L = ctypes.CDLL(so)
             L.hvd_pack.restype = ctypes.c_int64
             L.hvd_pack.argtypes = [ctypes.POINTER(ctypes.c_void_p),
                                    ctypes.POINTER(ctypes.c_int64),
@@ -82,11 +115,10 @@ def lib():
             L.hvd_tl_dropped.argtypes = [ctypes.c_void_p]
             if L.hvd_abi_version() != 1:
                 return None
-            _lib = L
+            _libs[so] = L
         except Exception as e:
             LOG.debug("native core load failed: %s", e)
-            _lib = None
-    return _lib
+    return _libs[so]
 
 
 def _pack_into(arrays, buf) -> None:
@@ -178,16 +210,19 @@ class StagingRing:
     runtime with a 128 MiB threshold does not pin slots×128 MiB."""
 
     def __init__(self, nbytes: int, slots: int = 4):
+        from ..utils import lockcheck
+
         self.capacity = max(0, int(nbytes))
         self.slots = max(1, int(slots))
-        self._lock = threading.Lock()
-        self._bufs = [None] * self.slots
-        self._tokens = [None] * self.slots
-        self._used = [False] * self.slots
+        self._lock = lockcheck.make_lock("native.staging_ring")
+        self._bufs = [None] * self.slots  # guarded-by: _lock
+        self._tokens = [None] * self.slots  # guarded-by: _lock
+        self._used = [False] * self.slots  # guarded-by: _lock
 
     def _inflight(self) -> int:
         n = 0
-        for t in self._tokens:
+        # internal helper: every caller already holds _lock
+        for t in self._tokens:  # hvdlint: disable=lock-discipline
             if t is _PENDING:
                 n += 1
             elif t is not None and not self._token_done(t):

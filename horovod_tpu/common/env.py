@@ -55,6 +55,13 @@ HOROVOD_RETRY_BASE_DELAY = "HOROVOD_RETRY_BASE_DELAY"
 # retries and the backoff scale between respawn rounds (elastic/driver.py)
 HOROVOD_ELASTIC_RESPAWN_ATTEMPTS = "HOROVOD_ELASTIC_RESPAWN_ATTEMPTS"
 HOROVOD_ELASTIC_RESPAWN_BACKOFF = "HOROVOD_ELASTIC_RESPAWN_BACKOFF"
+# elastic rendezvous identity: discovery epoch and reset generation
+# (driver-injected, read by elastic/state.py and the controller's
+# KV-scope prefix so stale rounds never cross a reset), plus the
+# committed-state snapshot path for elastic restore (elastic/state.py)
+HOROVOD_ELASTIC_EPOCH = "HOROVOD_ELASTIC_EPOCH"
+HOROVOD_ELASTIC_GEN = "HOROVOD_ELASTIC_GEN"
+HOROVOD_ELASTIC_STORE = "HOROVOD_ELASTIC_STORE"
 # steady-state fast path (docs/performance.md): staging-ring slot count,
 # escape hatch disabling compiled fused-chunk plans (legacy per-cycle
 # eager dispatch), and the backend liveness-probe timeout in seconds
@@ -69,6 +76,15 @@ HOROVOD_BACKEND_PROBE_TIMEOUT = "HOROVOD_BACKEND_PROBE_TIMEOUT"
 HOROVOD_TRACE = "HOROVOD_TRACE"
 HOROVOD_TRACE_BUFFER = "HOROVOD_TRACE_BUFFER"
 HOROVOD_TRACE_CLOCK_OFFSET = "HOROVOD_TRACE_CLOCK_OFFSET"
+# persistent jit compile cache directory toggle (utils/compile_cache.py)
+HOROVOD_COMPILE_CACHE = "HOROVOD_COMPILE_CACHE"
+# runtime lock-order/hold auditor (utils/lockcheck.py; docs/development.md):
+# master switch and the held-too-long warning threshold in milliseconds
+HOROVOD_LOCKCHECK = "HOROVOD_LOCKCHECK"
+HOROVOD_LOCKCHECK_HOLD_MS = "HOROVOD_LOCKCHECK_HOLD_MS"
+# native-core sanitizer build: address|thread adds the matching
+# -fsanitize flags to the on-demand g++ build (_native/__init__.py)
+HOROVOD_NATIVE_SANITIZE = "HOROVOD_NATIVE_SANITIZE"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -91,6 +107,8 @@ HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"  # jax.distributed coordinat
 HOROVOD_TPU_NUM_PROCESSES = "HOROVOD_TPU_NUM_PROCESSES"
 HOROVOD_TPU_PROCESS_ID = "HOROVOD_TPU_PROCESS_ID"
 HOROVOD_TPU_MESH = "HOROVOD_TPU_MESH"  # e.g. "dp=8" or "dp=4,tp=2"
+# skip building/loading the native C++ core (numpy fallbacks everywhere)
+HOROVOD_TPU_DISABLE_NATIVE = "HOROVOD_TPU_DISABLE_NATIVE"
 
 
 def get_bool(name: str, default: bool = False) -> bool:

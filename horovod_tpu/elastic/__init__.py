@@ -44,10 +44,11 @@ def _reinitialize():
     import os
 
     from ..common import context as ctx_mod
+    from ..common import env as env_schema
     from ..ops.collectives import clear_eager_cache
 
-    os.environ["HOROVOD_ELASTIC_GEN"] = str(
-        int(os.environ.get("HOROVOD_ELASTIC_GEN", "0")) + 1)
+    os.environ[env_schema.HOROVOD_ELASTIC_GEN] = str(
+        int(os.environ.get(env_schema.HOROVOD_ELASTIC_GEN, "0")) + 1)
 
     ctx_mod.shutdown(drain=False)
     clear_eager_cache()

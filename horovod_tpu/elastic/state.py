@@ -20,6 +20,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from ..common import env as env_schema
 from ..common.exceptions import HostsUpdatedInterrupt
 
 
@@ -94,9 +95,10 @@ class _HostUpdateListener:
     def __init__(self, carry: Optional[tuple] = None):
         import threading
 
-        self._seen_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
-        addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
-        port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        self._seen_epoch = int(
+            os.environ.get(env_schema.HOROVOD_ELASTIC_EPOCH, "0"))
+        addr = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+        port = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT)
         self.env_key = (addr, port)
         self._client = None
         self.change_count = 0
@@ -141,8 +143,8 @@ def _host_update_listener() -> _HostUpdateListener:
     States never keep watching a dead store; States re-resolve the
     singleton on every use rather than capturing a reference."""
     global _shared_listener
-    env_key = (os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
-               os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT"))
+    env_key = (os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR),
+               os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT))
     if _shared_listener is None or _shared_listener.env_key != env_key:
         carry = None
         if _shared_listener is not None:
@@ -167,7 +169,8 @@ class ObjectState(State):
 
         self._ckpt = ckpt
         self._ckpt_format = checkpoint_format
-        self._store_path = store_path or os.environ.get("HOROVOD_ELASTIC_STORE", "")
+        self._store_path = store_path or os.environ.get(
+            env_schema.HOROVOD_ELASTIC_STORE, "")
         self._saved: dict = {}
         self._attrs = list(kwargs.keys())
         for k, v in kwargs.items():

@@ -56,7 +56,9 @@ import threading
 import time
 from typing import Optional
 
+from ..common import env as env_schema
 from ..utils import faults as faults_mod
+from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import retry as retry_mod
 from ..utils import tracing as tracing_mod
@@ -78,8 +80,8 @@ def _ctl_prefix() -> str:
     (nobody serves their scope), hit their response timeout, and reinit
     again — converging on the highest generation.
     """
-    return (f"ctl/e{os.environ.get('HOROVOD_ELASTIC_EPOCH', '0')}"
-            f"g{os.environ.get('HOROVOD_ELASTIC_GEN', '0')}")
+    return (f"ctl/e{os.environ.get(env_schema.HOROVOD_ELASTIC_EPOCH, '0')}"
+            f"g{os.environ.get(env_schema.HOROVOD_ELASTIC_GEN, '0')}")
 
 
 def _ctl_scope(r: int) -> str:
@@ -364,8 +366,8 @@ class _Coordinator(threading.Thread):
         self.table: dict[str, tuple[list, set[int]]] = {}
         self.order: list[str] = []  # rank-0-submission-order tie break
         self.errors: dict[str, str] = {}
-        self._pending_params = None
-        self._params_lock = threading.Lock()
+        self._pending_params = None  # guarded-by: _params_lock
+        self._params_lock = lockcheck.make_lock("controller.params")
         self._down: set[int] = set()
         # rank -> last full submission (for SAME_AS_LAST fast-path decode)
         self._last_submission: dict[int, dict] = {}

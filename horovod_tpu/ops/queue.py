@@ -44,6 +44,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
 from . import collectives as C
@@ -74,9 +75,9 @@ class HandleManager:
     """Handle → status/result table (reference handle_manager.h:31)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._next = 0
-        self._results: dict[int, tuple[threading.Event, Any, Optional[BaseException]]] = {}
+        self._lock = lockcheck.make_lock("queue.handles")
+        self._next = 0  # guarded-by: _lock
+        self._results: dict[int, tuple[threading.Event, Any, Optional[BaseException]]] = {}  # guarded-by: _lock
 
     def allocate(self) -> int:
         with self._lock:
@@ -127,10 +128,10 @@ class TensorQueue:
     """Pending-op FIFO with in-flight name guard (reference tensor_queue.h)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._queue: list[TensorEntry] = []
-        self._in_flight: set[str] = set()
-        self._finalized = False
+        self._lock = lockcheck.make_lock("queue.pending")
+        self._queue: list[TensorEntry] = []  # guarded-by: _lock
+        self._in_flight: set[str] = set()  # guarded-by: _lock
+        self._finalized = False  # guarded-by: _lock
 
     def push(self, entry: TensorEntry):
         with self._lock:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..common import env as env_schema
 from .common.store import Store
 
 
@@ -190,7 +191,7 @@ class KerasEstimator:
         from .common.util import to_pandas
 
         if (self.sample_weight_col and self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             # fail BEFORE the driver-side collect (see spark/torch.py)
             raise ValueError(
                 "sample_weight_col with estimator-launched num_proc "
@@ -206,7 +207,7 @@ class KerasEstimator:
 
             w = pdf[self.sample_weight_col].to_numpy(np.float32)
         if (self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             # (sample_weight_col was rejected before the collect above)
             return self._fit_multiproc(x, y)
 
@@ -217,7 +218,7 @@ class KerasEstimator:
         import horovod_tpu.keras as hvd_keras
 
         distributed = False
-        if "HOROVOD_RANK" in os.environ:
+        if env_schema.HOROVOD_RANK in os.environ:
             if not hvd_keras.is_initialized():
                 hvd_keras.init()
             distributed = hvd_keras.cross_size() > 1
@@ -284,7 +285,7 @@ class KerasEstimator:
             raise ValueError("no staged dataset in the store and no "
                              "DataFrame to stage")
         if (self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             return self._fit_multiproc_store()
 
         import horovod_tpu.keras as hvd_keras
@@ -292,7 +293,7 @@ class KerasEstimator:
         from .common.datamodule import load_meta
 
         distributed = False
-        if "HOROVOD_RANK" in os.environ:
+        if env_schema.HOROVOD_RANK in os.environ:
             if not hvd_keras.is_initialized():
                 hvd_keras.init()
             distributed = hvd_keras.cross_size() > 1

@@ -15,6 +15,7 @@ from __future__ import annotations
 import io
 from typing import Optional, Sequence
 
+from ..common import env as env_schema
 from .common.store import Store
 from .common.util import dataframe_to_numpy, train_val_split
 
@@ -299,7 +300,7 @@ class TorchEstimator:
         from .common.util import to_pandas
 
         if (self.sample_weight_col and self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             # fail BEFORE the driver-side collect (all inputs to this
             # check are known already; collecting GBs first would waste
             # the most expensive step)
@@ -321,7 +322,7 @@ class TorchEstimator:
         (w, _), (w_val, _) = train_val_split(w, None, self.validation) \
             if w is not None else ((None, None), (None, None))
         if (self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             # estimator-launched distributed fit: spawn num_proc worker
             # processes (the reference estimator launches
             # horovod.spark.run the same way); each worker re-enters this
@@ -413,7 +414,7 @@ class TorchEstimator:
             raise ValueError("no staged dataset in the store and no "
                              "DataFrame to stage")
         if (self.num_proc and self.num_proc > 1
-                and "HOROVOD_RANK" not in os.environ):
+                and env_schema.HOROVOD_RANK not in os.environ):
             return self._fit_multiproc_store()
 
         import horovod_tpu.torch as hvd_torch

@@ -11,6 +11,8 @@ the framework.)
 import os
 from typing import Optional
 
+from ..common import env as env_schema
+
 
 def enable_compilation_cache(cache_dir: Optional[str] = None,
                              min_compile_time_secs: float = 1.0) -> bool:
@@ -22,7 +24,7 @@ def enable_compilation_cache(cache_dir: Optional[str] = None,
 
     try:
         cache_dir = (cache_dir
-                     or os.environ.get("HOROVOD_COMPILE_CACHE")
+                     or os.environ.get(env_schema.HOROVOD_COMPILE_CACHE)
                      or os.path.join(os.path.expanduser("~"), ".cache",
                                      "horovod_tpu_xla"))
         os.makedirs(cache_dir, exist_ok=True)

@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Optional
 
+from ..common import env as env_schema
 from ..common.exceptions import FaultInjectedError
 
 LOG = logging.getLogger("horovod_tpu")
@@ -99,7 +100,7 @@ class _Rule:
         self.hits = 0
         # deterministic per-(seed, site, rank) stream: a failing chaos run
         # replays bit-for-bit, and ranks draw distinct sequences
-        rank = os.environ.get("HOROVOD_RANK", "0")
+        rank = os.environ.get(env_schema.HOROVOD_RANK, "0")
         self._rng = random.Random(f"{seed}:{site}:{mode}:{rank}")
         self._lock = threading.Lock()
         self._metric = None

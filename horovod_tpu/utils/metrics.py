@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Optional
 
+from . import lockcheck
+
 LOG = logging.getLogger("horovod_tpu")
 
 # Default bucket tables (upper bounds, seconds / bytes / tensor counts).
@@ -191,9 +193,9 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("metrics.registry")
         # key: (name, sorted-label-items tuple) -> metric
-        self._metrics: dict[tuple, _Metric] = {}
+        self._metrics: dict[tuple, _Metric] = {}  # guarded-by: _lock
 
     def _get_or_create(self, cls, name, help_text, labels, **kw):
         key = (name, tuple(sorted(labels.items())))

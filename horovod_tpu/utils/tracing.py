@@ -65,6 +65,7 @@ import time
 from typing import Any, Optional
 
 from ..common import env as env_schema
+from . import lockcheck
 from . import metrics as metrics_mod
 
 LOG = logging.getLogger("horovod_tpu")
@@ -140,7 +141,7 @@ class _RingBuffer:
     def __init__(self):
         self._native = None
         self._q: Optional[queue_mod.SimpleQueue] = None
-        self._put_lock = threading.Lock()
+        self._put_lock = lockcheck.make_lock("tracing.ring_put")
         from .._native import lib as _native_lib
 
         try:
@@ -197,8 +198,8 @@ class Tracer:
         self.clock_uncertainty_s = clock_uncertainty_s
         self._ring = _RingBuffer()
         self._spans: collections.deque = collections.deque(
-            maxlen=max(int(buffer_limit), 1))
-        self._drain_lock = threading.Lock()
+            maxlen=max(int(buffer_limit), 1))  # guarded-by: _drain_lock
+        self._drain_lock = lockcheck.make_lock("tracing.drain")
         # begun/finished are plain ints bumped under the GIL: begin() runs
         # on caller threads, finish() on the cycle thread; an approximate
         # read is fine (open_spans is a diagnostic, not a sync primitive)
@@ -253,7 +254,10 @@ class Tracer:
 
     def records(self) -> list[dict]:
         self.drain()
-        return list(self._spans)
+        # the copy must also hold the lock: a dumper-thread drain()
+        # appending mid-iteration is a RuntimeError on a deque
+        with self._drain_lock:
+            return list(self._spans)
 
     def snapshot(self) -> dict:
         """Pushed-buffer form: rank identity + clock calibration + spans.

@@ -13,6 +13,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the whole suite runs under the lock-order/hold auditor
+# (utils/lockcheck.py): must be set before horovod_tpu is imported so
+# every runtime lock is created audited. A future inversion in the
+# background runtime fails the session below, without needing the
+# unlucky thread schedule that would deadlock.
+os.environ.setdefault("HOROVOD_LOCKCHECK", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -20,6 +27,7 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.utils import lockcheck  # noqa: E402
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -27,6 +35,13 @@ def _hvd_session():
     hvd.init()
     yield
     hvd.shutdown()
+    invs = lockcheck.inversions()
+    assert not invs, (
+        "lock-order inversion(s) detected during the test session:\n"
+        + "\n".join(
+            f"cycle {' -> '.join(i['cycle'])} (thread {i['thread']}):\n"
+            f"{i['stack']}\nreverse edge first acquired:\n{i['prior_stack']}"
+            for i in invs))
 
 
 @pytest.fixture(autouse=True)

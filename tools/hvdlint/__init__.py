@@ -1,0 +1,23 @@
+"""hvdlint — project-invariant static analysis for horovod_tpu.
+
+AST-based, dependency-free, pluggable. Run standalone::
+
+    python -m tools.hvdlint horovod_tpu [tests ...] [--json]
+
+or programmatically::
+
+    from tools.hvdlint import run_lint
+    findings = run_lint(["horovod_tpu"])
+
+See docs/development.md for the rule catalogue and how to add a rule.
+"""
+
+from .core import (  # noqa: F401
+    FileContext,
+    Finding,
+    Project,
+    find_repo_root,
+    lint_source,
+    run_lint,
+)
+from .rules import make_rules  # noqa: F401

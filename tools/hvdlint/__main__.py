@@ -1,0 +1,43 @@
+"""CLI: ``python -m tools.hvdlint [paths] [--json] [--root DIR]``.
+
+Exit status 0 when clean, 1 when any finding survives pragmas.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import find_repo_root, run_lint
+from .rules import make_rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hvdlint",
+        description="horovod_tpu project-invariant static analysis")
+    ap.add_argument("paths", nargs="*", default=["horovod_tpu"],
+                    help="files or directories to lint (default: horovod_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--root", default=None,
+                    help="repository root (default: ascend from first path)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["horovod_tpu"]
+    root = args.root or find_repo_root(paths[0])
+    rules = make_rules()
+    findings = run_lint(paths, root=root, rules=rules)
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"hvdlint: {len(findings)} finding(s), "
+              f"{len(rules)} rule(s) active", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""hvdlint engine: findings, pragmas, project context, and the runner.
+
+The framework is deliberately dependency-free (stdlib ``ast`` only, the
+same constraint as ``horovod_tpu/utils/metrics.py``): rules are pure
+functions over parsed trees plus a shared :class:`Project` context that
+carries the cross-file registries (env schema, fault sites, docs text).
+
+A rule is any object with::
+
+    name: str                   # kebab-case id used in pragmas/reports
+    check_file(ctx) -> iterable[Finding]   # per-file pass
+    finalize(project) -> iterable[Finding] # optional project-level pass
+
+Line-level suppression: ``# hvdlint: disable=<rule>[,<rule>...]`` on the
+flagged line (or ``disable=all``) drops the finding; the engine applies
+pragmas, rules never need to.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+# module that owns the env schema; the one file allowed to spell
+# HOROVOD_* literals
+ENV_SCHEMA_REL = "horovod_tpu/common/env.py"
+FAULTS_REL = "horovod_tpu/utils/faults.py"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file plus its pragma map."""
+
+    def __init__(self, path: str, source: str, project: "Project"):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.project = project
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                self.pragmas[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        tags = self.pragmas.get(line)
+        return bool(tags) and (rule in tags or "all" in tags)
+
+    def in_package(self) -> bool:
+        """True when the file belongs to the runtime package (rules that
+        enforce package-code discipline skip tests/benchmarks/tools)."""
+        return "horovod_tpu/" in self.path or \
+            self.path.startswith("horovod_tpu")
+
+
+def _module_str_constants(tree: ast.Module, prefix: str) -> Dict[str, str]:
+    """Module-level ``NAME = "<prefix>..."`` assignments, value -> name."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith(prefix):
+            out[node.value.value] = node.targets[0].id
+    return out
+
+
+def _env_constant_lines(tree: ast.Module) -> Dict[str, int]:
+    """Env-string value -> line of its schema assignment (for findings)."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith("HOROVOD_"):
+            out[node.value.value] = node.lineno
+    return out
+
+
+def _fault_sites(tree: ast.Module) -> Set[str]:
+    """The declared ``SITES`` tuple in utils/faults.py."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SITES" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+class Project:
+    """Cross-file context shared by all rules.
+
+    Every field is plain data so tests can construct a synthetic Project
+    for fixture snippets without touching the real repository.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        # env-string value -> schema constant name (e.g. "HOROVOD_TRACE"
+        # -> "HOROVOD_TRACE"); empty when no schema file was found
+        self.env_constants: Dict[str, str] = {}
+        self.env_constant_lines: Dict[str, int] = {}
+        # declared fault sites from utils/faults.py SITES
+        self.fault_sites: Set[str] = set()
+        # doc filename -> full text (for presence checks)
+        self.docs: Dict[str, str] = {}
+
+    @classmethod
+    def from_root(cls, root: str) -> "Project":
+        p = cls(root=root)
+        schema = os.path.join(root, ENV_SCHEMA_REL)
+        if os.path.exists(schema):
+            with open(schema, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=schema)
+            p.env_constants = _module_str_constants(tree, "HOROVOD_")
+            p.env_constant_lines = _env_constant_lines(tree)
+        faults = os.path.join(root, FAULTS_REL)
+        if os.path.exists(faults):
+            with open(faults, encoding="utf-8") as f:
+                p.fault_sites = _fault_sites(ast.parse(f.read(), filename=faults))
+        for doc in ("running.md", "observability.md"):
+            path = os.path.join(root, "docs", doc)
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    p.docs[doc] = f.read()
+        return p
+
+    def doc_mentions(self, doc: str, token: str) -> bool:
+        """Word-boundary presence check (``HOROVOD_ELASTIC`` must not be
+        satisfied by ``HOROVOD_ELASTIC_STORE``; ``_`` counts as a word
+        character, so ``\\b`` gives exactly that)."""
+        text = self.docs.get(doc)
+        if text is None:
+            return True  # doc absent: presence rules stand down
+        return re.search(r"\b%s\b" % re.escape(token), text) is not None
+
+
+def find_repo_root(start: str) -> str:
+    """Ascend until a directory containing horovod_tpu/common/env.py."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, ENV_SCHEMA_REL)):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_source(source: str, path: str, project: Project,
+                rules: Optional[list] = None) -> List[Finding]:
+    """Lint one in-memory source string (tests feed fixture snippets
+    through this; ``path`` decides which per-path rules apply)."""
+    from . import rules as rules_mod
+
+    active = rules if rules is not None else rules_mod.make_rules()
+    ctx = FileContext(path, source, project)
+    out: List[Finding] = []
+    for rule in active:
+        for f in rule.check_file(ctx):
+            if not ctx.suppressed(rule.name, f.line):
+                out.append(f)
+    return out
+
+
+def run_lint(paths: Iterable[str], root: Optional[str] = None,
+             rules: Optional[list] = None) -> List[Finding]:
+    """Lint ``paths`` (files or directories) and return all findings.
+
+    ``root`` locates the repository (env schema, fault sites, docs); when
+    omitted it is derived by ascending from the first path.
+    """
+    from . import rules as rules_mod
+
+    paths = list(paths)
+    if root is None:
+        root = find_repo_root(paths[0] if paths else os.getcwd())
+    project = Project.from_root(root)
+    active = rules if rules is not None else rules_mod.make_rules()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(os.path.abspath(path), root)
+        if rel.startswith(".."):
+            rel = path
+        try:
+            ctx = FileContext(rel, source, project)
+        except SyntaxError as e:
+            findings.append(Finding("parse", rel, e.lineno or 0,
+                                    f"syntax error: {e.msg}"))
+            continue
+        for rule in active:
+            for fd in rule.check_file(ctx):
+                if not ctx.suppressed(rule.name, fd.line):
+                    findings.append(fd)
+    for rule in active:
+        finalize = getattr(rule, "finalize", None)
+        if finalize is not None:
+            findings.extend(finalize(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

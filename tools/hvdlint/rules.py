@@ -1,0 +1,376 @@
+"""The project-specific rule set.
+
+Each rule is a small class: ``check_file(ctx)`` yields per-file findings,
+``finalize(project)`` (optional) yields project-level findings once every
+file has been seen. Rules never apply pragmas — the engine does.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from .core import ENV_SCHEMA_REL, FileContext, Finding, Project
+
+METRIC_NAME_RE = re.compile(r"^hvd_[a-z0-9]+(_[a-z0-9]+)*$")
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+# modules that speak the negotiation wire format: timestamps that cross
+# ranks must come from the aligned clock, never bare time.time()
+WIRE_MODULES = ("horovod_tpu/ops/controller.py",)
+
+
+def _is_os_environ(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def _str_const(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class EnvDisciplineRule:
+    """HOROVOD_* env access must go through the common/env.py schema, and
+    every schema constant must be documented in docs/running.md."""
+
+    name = "env-discipline"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package() or ctx.path.endswith("common/env.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            key = None
+            if isinstance(node, ast.Subscript) and _is_os_environ(node.value):
+                key = _str_const(node.slice)
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("get", "setdefault", "pop") \
+                        and _is_os_environ(node.func.value) and node.args:
+                    key = _str_const(node.args[0])
+                elif node.func.attr == "getenv" \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "os" and node.args:
+                    key = _str_const(node.args[0])
+            elif isinstance(node, ast.Compare) \
+                    and any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                    and any(_is_os_environ(c) for c in node.comparators):
+                key = _str_const(node.left)
+            if key is None or not key.startswith("HOROVOD_"):
+                continue
+            const = ctx.project.env_constants.get(key)
+            if const:
+                hint = f"use env_schema.{const} from common/env.py"
+            else:
+                hint = ("no schema constant exists — add one to "
+                        "common/env.py first")
+            yield Finding(self.name, ctx.path, node.lineno,
+                          f"os.environ access with raw literal {key!r} "
+                          f"bypasses the env schema; {hint}")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        for value in sorted(project.env_constants):
+            if not project.doc_mentions("running.md", value):
+                yield Finding(
+                    self.name, ENV_SCHEMA_REL,
+                    project.env_constant_lines.get(value, 1),
+                    f"schema constant {value} is not documented in "
+                    "docs/running.md")
+
+
+class MetricNamesRule:
+    """Every literal hvd_* series registered via counter()/gauge()/
+    histogram() must be snake_case, kind-unique, and documented in
+    docs/observability.md."""
+
+    name = "metric-names"
+    _KINDS = ("counter", "gauge", "histogram")
+
+    def __init__(self):
+        self._seen: Dict[str, Tuple[str, str, int]] = {}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._KINDS and node.args):
+                continue
+            mname = _str_const(node.args[0])
+            if mname is None or not mname.startswith("hvd_"):
+                continue
+            kind = node.func.attr
+            if not METRIC_NAME_RE.match(mname):
+                yield Finding(self.name, ctx.path, node.lineno,
+                              f"metric name {mname!r} is not snake_case "
+                              "(expected ^hvd_[a-z0-9_]+$)")
+            prev = self._seen.get(mname)
+            if prev is None:
+                self._seen[mname] = (kind, ctx.path, node.lineno)
+            elif prev[0] != kind:
+                yield Finding(
+                    self.name, ctx.path, node.lineno,
+                    f"metric {mname!r} registered as {kind} here but as "
+                    f"{prev[0]} at {prev[1]}:{prev[2]} — one series, one kind")
+            if not ctx.project.doc_mentions("observability.md", mname):
+                yield Finding(self.name, ctx.path, node.lineno,
+                              f"metric {mname!r} is not documented in "
+                              "docs/observability.md")
+
+
+class FaultSitesRule:
+    """Fault sites armed anywhere (package or tests) — fault_point()/
+    corrupt() calls and literal HOROVOD_FAULT_SPEC values — must name a
+    site declared in utils/faults.py SITES."""
+
+    name = "fault-sites"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        sites = ctx.project.fault_sites
+        if not sites:  # no registry loaded (synthetic project): stand down
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if fname in ("fault_point", "corrupt") and node.args:
+                site = _str_const(node.args[0])
+                if site is not None and site not in sites:
+                    yield Finding(
+                        self.name, ctx.path, node.lineno,
+                        f"{fname}() arms undeclared site {site!r}; declared "
+                        f"sites: {', '.join(sorted(sites))}")
+            spec = None
+            if fname == "setenv" and len(node.args) >= 2 \
+                    and _str_const(node.args[0]) == "HOROVOD_FAULT_SPEC":
+                spec = _str_const(node.args[1])
+            elif fname == "setdefault" and isinstance(fn, ast.Attribute) \
+                    and _is_os_environ(fn.value) and len(node.args) >= 2 \
+                    and _str_const(node.args[0]) == "HOROVOD_FAULT_SPEC":
+                spec = _str_const(node.args[1])
+            if spec is not None:
+                yield from self._check_spec(ctx, node.lineno, spec, sites)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Subscript) \
+                    and _is_os_environ(node.targets[0].value) \
+                    and _str_const(node.targets[0].slice) == "HOROVOD_FAULT_SPEC":
+                spec = _str_const(node.value)
+                if spec is not None:
+                    yield from self._check_spec(ctx, node.lineno, spec, sites)
+
+    def _check_spec(self, ctx, lineno, spec, sites) -> Iterable[Finding]:
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site = entry.split(":", 1)[0].strip()
+            if site not in sites:
+                yield Finding(
+                    self.name, ctx.path, lineno,
+                    f"HOROVOD_FAULT_SPEC entry {entry!r} arms undeclared "
+                    f"site {site!r}")
+
+
+# terminal identifiers that mark a "feature handle" guard: the zero-cost
+# contract says a disabled tracer/timeline/fault state costs one is-None
+# check, so nothing may allocate or read clocks before that check
+_GUARD_SUFFIXES = ("tracer", "timeline", "span", "auditor")
+_GUARD_NAMES = {"st", "state", "tl"}
+
+
+def _guardish_name(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Name):
+        n = expr.id.lower()
+    elif isinstance(expr, ast.Attribute):
+        n = expr.attr.lower()
+    else:
+        return False
+    return n in _GUARD_NAMES or any(n.endswith(s) for s in _GUARD_SUFFIXES)
+
+
+def _is_none_guard(stmt: ast.stmt) -> bool:
+    """``if <handle> is None: return/raise`` as a top-level statement."""
+    if not isinstance(stmt, ast.If) or not isinstance(stmt.test, ast.Compare):
+        return False
+    t = stmt.test
+    if len(t.ops) != 1 or not isinstance(t.ops[0], ast.Is):
+        return False
+    if not (isinstance(t.comparators[0], ast.Constant)
+            and t.comparators[0].value is None):
+        return False
+    if not _guardish_name(t.left):
+        return False
+    return all(isinstance(s, (ast.Return, ast.Raise, ast.Pass))
+               for s in stmt.body)
+
+
+class ZeroCostHooksRule:
+    """Functions with a top-level ``if <tracer/timeline/state> is None:
+    return`` guard must not allocate, format strings, or call time.*
+    before that guard."""
+
+    name = "zero-cost-hooks"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guard_idx = None
+            for i, stmt in enumerate(fn.body):
+                if _is_none_guard(stmt):
+                    guard_idx = i
+                    break
+            if guard_idx is None or guard_idx == 0:
+                continue
+            for stmt in fn.body[:guard_idx]:
+                yield from self._scan(ctx, fn.name, stmt)
+
+    def _scan(self, ctx, fname, stmt) -> Iterable[Finding]:
+        for node in ast.walk(stmt):
+            bad = None
+            if isinstance(node, ast.JoinedStr):
+                bad = "builds an f-string"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                if isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "time":
+                    bad = f"calls time.{node.func.attr}()"
+                elif node.func.attr == "format":
+                    bad = "calls .format()"
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) \
+                    and _str_const(node.left) is not None:
+                bad = "%-formats a string"
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                bad = "allocates via a comprehension"
+            if bad:
+                yield Finding(
+                    self.name, ctx.path, node.lineno,
+                    f"{fname}() {bad} before its is-None feature guard — "
+                    "the disabled path must cost one check")
+
+
+class LockDisciplineRule:
+    """``self.<attr>  # guarded-by: <lock>`` attributes may only be
+    touched inside ``with self.<lock>:`` in that class (the declaring
+    method — usually __init__ — is exempt)."""
+
+    name = "lock-discipline"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        annotations = []  # (line, lockname)
+        for i, line in enumerate(ctx.lines, start=1):
+            m = GUARDED_BY_RE.search(line)
+            if m:
+                annotations.append((i, m.group(1)))
+        if not annotations:
+            return
+        classes = [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]
+        for line, lock in annotations:
+            target = self._annotated_attr(classes, line)
+            if target is None:
+                yield Finding(self.name, ctx.path, line,
+                              "dangling '# guarded-by' annotation: no "
+                              "self.<attr> assignment on this line")
+                continue
+            cls, owner_fn, attr = target
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if fn is owner_fn:
+                    continue
+                yield from self._check_fn(ctx, cls, fn, attr, lock)
+
+    @staticmethod
+    def _annotated_attr(classes, line):
+        """The (class, method, attr) of the self.<attr> assignment whose
+        source span covers the annotated line."""
+        for cls in classes:
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    if not (node.lineno <= line <= (node.end_lineno or node.lineno)):
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            return cls, fn, t.attr
+        return None
+
+    def _check_fn(self, ctx, cls, fn, attr, lock) -> Iterable[Finding]:
+        def holds_lock(withstmt: ast.With) -> bool:
+            for item in withstmt.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and e.attr == lock \
+                        and isinstance(e.value, ast.Name) and e.value.id == "self":
+                    return True
+            return False
+
+        def visit(node, held: bool):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and node.attr == attr \
+                    and not held:
+                yield Finding(
+                    self.name, ctx.path, node.lineno,
+                    f"{cls.name}.{fn.name} touches self.{attr} outside "
+                    f"'with self.{lock}:' (declared guarded-by: {lock})")
+            child_held = held
+            if isinstance(node, (ast.With, ast.AsyncWith)) and holds_lock(node):
+                child_held = True
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, child_held)
+
+        for stmt in fn.body:
+            yield from visit(stmt, False)
+
+
+class WallClockRule:
+    """Wire-format/negotiation modules must never read bare time.time();
+    cross-rank timestamps come from the tracer's aligned clock (span
+    stamping elsewhere deliberately records raw local time — offsets are
+    applied at merge, see docs/timeline.md)."""
+
+    name = "wallclock-hygiene"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not any(ctx.path.endswith(m) for m in WIRE_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "time" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "time":
+                yield Finding(
+                    self.name, ctx.path, node.lineno,
+                    "bare time.time() on a wire-format path — use the "
+                    "tracer's aligned_now() (utils/tracing.py) for "
+                    "cross-rank timestamps, time.monotonic() for durations")
+
+
+def make_rules() -> List:
+    """Fresh instances of every active rule (stateful rules accumulate
+    per-run, so each run_lint() gets its own set)."""
+    return [
+        EnvDisciplineRule(),
+        MetricNamesRule(),
+        FaultSitesRule(),
+        ZeroCostHooksRule(),
+        LockDisciplineRule(),
+        WallClockRule(),
+    ]
