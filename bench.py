@@ -574,6 +574,27 @@ def _emit_result(stdout_text: str, stderr_text: str = "") -> bool:
     return True
 
 
+def _diag_artifacts(diag_dir: str, max_age_s: float = 7200.0) -> list:
+    """Recent diagnostic bundle files (utils/diag.py) under ``diag_dir``
+    — the failure artifact a dead bench leg leaves behind. Age-bounded so
+    a long-lived temp dir's stale bundles from earlier rounds are not
+    misattributed to this run."""
+    import glob
+    import time as _time
+
+    out = []
+    try:
+        for p in sorted(glob.glob(os.path.join(diag_dir, "hvd_diag.*.json"))):
+            try:
+                if _time.time() - os.path.getmtime(p) <= max_age_s:
+                    out.append(p)
+            except OSError:
+                continue
+    except Exception:
+        pass
+    return out
+
+
 def _parent_main() -> int:
     """Hang-proof wrapper (the __graft_entry__ discipline: the parent
     NEVER touches the JAX backend — on a wedged tunnel even backend
@@ -587,6 +608,15 @@ def _parent_main() -> int:
     # surface as a zero-value artifact mislabeled by the fallback chain
     env = dict(os.environ)
     env[_BENCH_CHILD] = "1"
+    # postmortem layer for the child: a wedged/killed child leaves
+    # diagnostic bundles (utils/diag.py — thread stacks, flight events)
+    # in a directory the failure path below can harvest. setdefault: the
+    # operator's values win.
+    import tempfile
+
+    env.setdefault("HOROVOD_DIAG_DIR", tempfile.gettempdir())
+    env.setdefault("HOROVOD_FLIGHTREC", "1")
+    env.setdefault("HOROVOD_WATCHDOG_SECS", "300")
     args = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
     # stage 1: a probe child decides whether the backend is usable at all
     # — a wedged tunnel HANGS inside backend init (it does not raise), and
@@ -611,6 +641,10 @@ def _parent_main() -> int:
         except subprocess.TimeoutExpired:
             err = f"TPU bench child timed out after {child_timeout} s"
     sys.stderr.write(f"bench: TPU run failed, falling back to CPU: {err}\n")
+    diag_files = _diag_artifacts(env["HOROVOD_DIAG_DIR"])
+    if diag_files:
+        sys.stderr.write("bench: diagnostic bundles left by the failed "
+                         "child:\n" + "".join(f"  {p}\n" for p in diag_files))
     env["JAX_PLATFORMS"] = "cpu"
     for trigger in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
         env.pop(trigger, None)
@@ -640,7 +674,8 @@ def _parent_main() -> int:
         "metric": metric, "value": 0.0,
         "unit": "images/sec/chip", "mfu": 0.0, "vs_baseline": 0.0,
         "extras": {"error": fb_err.replace("\n", " "),
-                   "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"]},
+                   "fallback_reason": env["HVD_BENCH_FALLBACK_REASON"],
+                   "diag_bundles": _diag_artifacts(env["HOROVOD_DIAG_DIR"])},
     })
     _write_result_file(line)
     print(line)

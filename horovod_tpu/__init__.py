@@ -112,6 +112,19 @@ def trace_report() -> dict:
     return _tracing.report()
 
 
+def diagnose() -> dict:
+    """The local diagnostic bundle (utils/diag.py): all-thread stacks,
+    lockcheck state, a metrics snapshot, open tracing spans, the flight
+    recorder's last events, and live-state probes (background-cycle beat,
+    coordinator gather state). This is what the wedge watchdog dumps on a
+    hang and what ``GET /debug`` on the rendezvous server merges across
+    ranks — callable any time, init or not, for on-demand inspection.
+    See docs/observability.md, "Debugging a hung job"."""
+    from .utils import diag as _diag
+
+    return _diag.build_bundle("diagnose")
+
+
 # ---------------------------------------------------------------------------
 # Async handle-based API (reference torch/mpi_ops.py:843-879: *_async, poll,
 # synchronize, wait_and_clear)

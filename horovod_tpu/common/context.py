@@ -268,6 +268,10 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
         _ctx.process_sets = {"global": _ctx.global_set}
         _ctx.joined = False
 
+        # postmortem layer BEFORE the runtime/controller construct: both
+        # resolve the recorder/watchdog handles once at build time
+        _start_diag()
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)
@@ -304,6 +308,9 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 stall_inspector=_ctx.stall_inspector,
             )
             _ctx.runtime.start()
+            from ..utils import flightrec as flightrec_mod
+
+            flightrec_mod.note("init_phase", phase="runtime_started")
             if _ctx.config.autotune:
                 from ..utils.autotune import Autotuner
 
@@ -316,7 +323,39 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                     _ctx.config.autotune_steps_per_sample)
         _start_metrics_dumper()
         _ctx.initialized = True
+        from ..utils import flightrec as flightrec_mod
+
+        flightrec_mod.note("init_phase", phase="initialized")
         LOG.info("horovod_tpu initialized: %s", _ctx.global_set)
+
+
+def _start_diag():
+    """Arm the postmortem layer (utils/flightrec.py + utils/diag.py):
+    the flight recorder (``HOROVOD_FLIGHTREC``), the wedge watchdog
+    (``HOROVOD_WATCHDOG_SECS`` > 0), the signal/crash dump hooks, and —
+    in a launched job — a dedicated KV client so watchdog/crash bundles
+    ride the push path into the launcher's ``GET /debug``. With both
+    knobs off, nothing is created and no hook is installed."""
+    from ..utils import diag as diag_mod
+    from ..utils import flightrec as flightrec_mod
+
+    recorder = flightrec_mod.init_recorder(rank=_ctx.global_set.cross_rank)
+    flightrec_mod.note("init_phase", phase="config")
+    wd = diag_mod.init_watchdog(_ctx.config.watchdog_secs)
+    if recorder is None and wd is None:
+        return
+    addr = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+    port = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT)
+    if addr and port:
+        from ..runner.http_server import KVStoreClient
+
+        # NOT the MetricsDumper's client: dumps fire from the watchdog /
+        # signal context concurrently with the dumper cadence, and the
+        # keep-alive socket is per-thread state
+        diag_mod.set_kv_client(KVStoreClient(addr, int(port)))
+    # after _install_fatal_exit_hook (in _maybe_init_distributed), so the
+    # excepthook chain runs dump-first, then print-and-os._exit
+    diag_mod.install_crash_hooks()
 
 
 def _start_metrics_dumper():
@@ -372,6 +411,13 @@ def shutdown(drain: bool = True):
             # reflects everything the drained runtime counted
             _ctx.metrics_dumper.stop()
             _ctx.metrics_dumper = None
+        from ..utils import diag as diag_mod
+
+        # the flight recorder survives shutdown (one continuous ring per
+        # process, like the metrics registry); the watchdog thread and
+        # its KV client do not
+        diag_mod.reset_watchdog()
+        diag_mod.set_kv_client(None)
         _ctx.stall_inspector = None
         _ctx.autotuner = None
         _ctx.global_set = None

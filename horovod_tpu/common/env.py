@@ -85,6 +85,14 @@ HOROVOD_LOCKCHECK_HOLD_MS = "HOROVOD_LOCKCHECK_HOLD_MS"
 # native-core sanitizer build: address|thread adds the matching
 # -fsanitize flags to the on-demand g++ build (_native/__init__.py)
 HOROVOD_NATIVE_SANITIZE = "HOROVOD_NATIVE_SANITIZE"
+# postmortem layer (utils/flightrec.py + utils/diag.py;
+# docs/observability.md "Debugging a hung job"): flight-recorder master
+# switch and ring capacity, the wedge-watchdog no-progress threshold in
+# seconds (0 = off), and where diagnostic bundles are written
+HOROVOD_FLIGHTREC = "HOROVOD_FLIGHTREC"
+HOROVOD_FLIGHTREC_BUFFER = "HOROVOD_FLIGHTREC_BUFFER"
+HOROVOD_WATCHDOG_SECS = "HOROVOD_WATCHDOG_SECS"
+HOROVOD_DIAG_DIR = "HOROVOD_DIAG_DIR"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -184,6 +192,12 @@ class RuntimeConfig:
     # straggler attribution — off by default (zero-cost contract)
     trace_enabled: bool = False
     trace_buffer: int = 4096
+    # postmortem layer (utils/flightrec.py, utils/diag.py) — all off by
+    # default (flight recorder zero-cost, watchdog thread not created)
+    flightrec_enabled: bool = False
+    flightrec_buffer: int = 2048
+    watchdog_secs: float = 0.0
+    diag_dir: str = ""
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -222,4 +236,9 @@ class RuntimeConfig:
         c.fused_plan_disable = get_bool(HOROVOD_FUSED_PLAN_DISABLE)
         c.trace_enabled = get_bool(HOROVOD_TRACE)
         c.trace_buffer = get_int(HOROVOD_TRACE_BUFFER, c.trace_buffer)
+        c.flightrec_enabled = get_bool(HOROVOD_FLIGHTREC)
+        c.flightrec_buffer = get_int(HOROVOD_FLIGHTREC_BUFFER,
+                                     c.flightrec_buffer)
+        c.watchdog_secs = get_float(HOROVOD_WATCHDOG_SECS, c.watchdog_secs)
+        c.diag_dir = get_str(HOROVOD_DIAG_DIR)
         return c

@@ -122,6 +122,9 @@ def probe_backend(timeout_s: float | None = None, force: bool = False):
         err = (f"backend probe hung for {timeout_s:g} s (wedged tunnel)"
                if isinstance(e, subprocess.TimeoutExpired)
                else f"backend probe failed to launch: {e}")
+    from ..utils import flightrec
+
+    flightrec.note("probe_verdict", ok=ok, err=err)
     _BACKEND_PROBE_VERDICT["verdict"] = (ok, err)
     return ok, err
 

@@ -213,6 +213,9 @@ def invalidate_fused_plans() -> int:
         m = _plan_metrics()
         m[3].inc(len(stale))
         m[4].set(0)
+        from ..utils import flightrec
+
+        flightrec.note("plan_cache_invalidated", count=len(stale))
     return len(stale)
 
 

@@ -57,6 +57,7 @@ import time
 from typing import Optional
 
 from ..common import env as env_schema
+from ..utils import diag as diag_mod
 from ..utils import faults as faults_mod
 from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
@@ -398,6 +399,16 @@ class _Coordinator(threading.Thread):
         self._m_stall_warn = reg.counter(
             "hvd_coordinator_stall_warnings_total",
             "coordinator stall warnings (round or per-tensor)")
+        # gather-in-progress view for diagnostic bundles: reassigned as a
+        # fresh dict each poll (atomic reference swap — the diag probe
+        # reads it lock-free from the watchdog thread). THE attribution
+        # signal for GET /debug: the ranks the coordinator is waiting on
+        # are the wedge by definition (diag.merge_bundles).
+        self._gather_state: dict = {}
+        diag_mod.register_probe("coordinator", self._diag_probe)
+
+    def _diag_probe(self) -> dict:
+        return dict(self._gather_state)
 
     # Per-attempt poll while gathering a round. Short so a stalled round is
     # noticed and attributed within ~stall_warning_s, not after a silent
@@ -488,13 +499,18 @@ class _Coordinator(threading.Thread):
                     except Exception:
                         continue  # straggler: keep polling this rank
             elapsed = _time.monotonic() - start
+            self._gather_state = {"round": r,
+                                  "missing_ranks": sorted(missing),
+                                  "elapsed_s": round(elapsed, 3)}
             if missing and elapsed - warned_at > self.stall_warning_s:
                 self._warn_stall(r, missing, elapsed)
                 warned_at = elapsed
             if (missing and self.stall_shutdown_s > 0
                     and elapsed > self.stall_shutdown_s):
                 self._error_close_round(r, missing, elapsed)
+                self._gather_state = {}
                 return None
+        self._gather_state = {}
         return got if not missing else None
 
     def run(self):
@@ -728,4 +744,5 @@ class _Coordinator(threading.Thread):
         return strag
 
     def stop(self):
+        diag_mod.unregister_probe("coordinator")
         self._stop_evt.set()

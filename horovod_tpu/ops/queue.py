@@ -44,6 +44,8 @@ from typing import Any, Optional
 import numpy as np
 
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..utils import diag as diag_mod
+from ..utils import flightrec as flightrec_mod
 from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
@@ -236,6 +238,11 @@ class BackgroundRuntime:
         # single ``is not None`` check (the zero-cost contract enforced by
         # benchmarks/trace_overhead.py)
         self.tracer = tracing_mod.get_tracer()
+        # postmortem layer, same resolved-once contract
+        # (benchmarks/flightrec_overhead.py): None handles keep the cycle
+        # loop and negotiation bracket at one is-None check each
+        self.recorder = flightrec_mod.get_recorder()
+        self.watchdog = diag_mod.get_watchdog()
         self.controller = self._maybe_controller()
         if self.controller is not None:
             self.controller.on_params = self._apply_tuned_params
@@ -368,8 +375,24 @@ class BackgroundRuntime:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="hvd-cycle")
         self._thread.start()
+        # live-state probe for diagnostic bundles: only started runtimes
+        # register (the overhead benches build private non-started ones)
+        diag_mod.register_probe("runtime", self._diag_probe)
+
+    def _diag_probe(self) -> dict:
+        state = {
+            "cycles": self.cycles,
+            "work_cycles": self.work_cycles,
+            "pending": len(self._pending),
+            "joined": self.joined,
+            "controller": self.controller is not None,
+        }
+        if self.watchdog is not None:
+            state["watchdog"] = self.watchdog.state()
+        return state
 
     def stop(self, drain: bool = True):
+        diag_mod.unregister_probe("runtime")
         self._stop.set()
         self._wake.set()
         cycle_exited = True
@@ -399,6 +422,8 @@ class BackgroundRuntime:
     # -- cycle ---------------------------------------------------------------
     def _loop(self):
         while not self._stop.is_set():
+            if self.watchdog is not None:
+                self.watchdog.beat()
             self._wake.wait(timeout=self.cycle_time_ms / 1000.0)
             self._wake.clear()
             if self._stop.is_set():
@@ -520,9 +545,18 @@ class BackgroundRuntime:
                         and e.span.t[tracing_mod.T_NEG_START] is None:
                     e.span.t[tracing_mod.T_NEG_START] = now
                     e.span.round = rnd
+        if self.recorder is not None:
+            self.recorder.note("negotiation_round", state="begin",
+                               round=rnd, tensors=len(sigs))
+        if self.watchdog is not None:
+            # an in-flight negotiation blocks the cycle loop by design;
+            # the phase bracket lets a fire name it (vs a dead loop)
+            self.watchdog.enter("negotiate")
+        ok = False
         try:
             resp = self.controller.negotiate(sigs, joined=self.joined)
             ready, errors = resp["ready"], resp["errors"]
+            ok = True
         except Exception as exc:
             # Fail everything — including on shutdown: a silent return would
             # leak handles a caller may be blocked on in hvd.wait().
@@ -536,6 +570,12 @@ class BackgroundRuntime:
                 self._finish(e, None, err)
             self._pending.clear()
             return []
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.exit_phase("negotiate")
+            if self.recorder is not None:
+                self.recorder.note("negotiation_round", state="end",
+                                   round=rnd, ok=ok)
         for n, msg in errors.items():
             e = self._pending.pop(n, None)
             if e is not None:

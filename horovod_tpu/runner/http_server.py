@@ -99,6 +99,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_clock()
         if key == "timeline":
             return self._do_timeline()
+        if key == "debug":
+            return self._do_debug()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -142,14 +144,32 @@ class _KVHandler(BaseHTTPRequestHandler):
         with store.cond:
             pushed = {k: v for k, v in store.data.items()
                       if k.startswith(scope_prefix)}
-        snaps = [({}, metrics_mod.get_registry().snapshot())]
+        worker = []
         for k, v in sorted(pushed.items()):
             suffix = k[len(scope_prefix):]  # "rank3"
             rank = suffix[4:] if suffix.startswith("rank") else suffix
             try:
-                snaps.append(({"rank": rank}, json.loads(v)))
+                snap = json.loads(v)
             except (ValueError, UnicodeDecodeError):
                 continue  # half-written push: skip, next scrape catches up
+            worker.append((rank, snap))
+        # elastic continuity: after a resize, ranks of the previous
+        # generation keep their last-pushed snapshot in the store (they
+        # may not exist anymore to overwrite it). Keep only the newest
+        # (epoch, gen) present — a departed rank's stale series would
+        # otherwise report frozen counters forever.
+        def _gen(snap):
+            try:
+                return (int(snap.get("elastic_epoch", 0)),
+                        int(snap.get("elastic_gen", 0)))
+            except (TypeError, ValueError):
+                return (0, 0)
+
+        if worker:
+            newest = max(_gen(s) for _, s in worker)
+            worker = [(r, s) for r, s in worker if _gen(s) == newest]
+        snaps = [({}, metrics_mod.get_registry().snapshot())]
+        snaps.extend(({"rank": r}, s) for r, s in worker)
         body = metrics_mod.render_snapshots(snaps).encode()
         self.send_response(200)
         self.send_header("Content-Type",
@@ -203,6 +223,38 @@ class _KVHandler(BaseHTTPRequestHandler):
                 continue  # local tracer is this rank's fresher view
             buffers.append(buf)
         body = json.dumps(tracing_mod.merge_chrome_trace(buffers)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_debug(self):
+        """``GET /debug``: merge every diagnostic bundle ranks pushed
+        under the ``diag/`` KV scope (watchdog fires, signal dumps,
+        crashes — utils/diag.py) into one attribution view that *names
+        the wedged rank* (diag.merge_bundles). Auth-exempt read-only
+        telemetry, same rationale as ``/metrics`` — this is precisely the
+        endpoint an operator hits when the job is too wedged to sign
+        anything."""
+        import json
+
+        from ..utils import diag as diag_mod
+
+        store = self.server.store  # type: ignore[attr-defined]
+        scope_prefix = diag_mod.KV_SCOPE + "/"
+        with store.cond:
+            pushed = {k: v for k, v in store.data.items()
+                      if k.startswith(scope_prefix)}
+        bundles = {}
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank1"
+            try:
+                rank = int(suffix[4:] if suffix.startswith("rank") else suffix)
+                bundles[rank] = json.loads(v)
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+        body = json.dumps(diag_mod.merge_bundles(bundles)).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

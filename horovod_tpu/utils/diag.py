@@ -1,0 +1,443 @@
+"""Postmortem diagnostics: wedge watchdog, bundle builder, crash hooks.
+
+Everything here exists for one question: *what was every rank doing when
+it stopped making progress?* The answer is a **diagnostic bundle** — a
+JSON document with all-thread stacks (``sys._current_frames``), the
+lockcheck held-lock/inversion report, a metrics snapshot, open tracing
+spans, the flight recorder's last events (utils/flightrec.py), and
+live-state probes contributed by the runtime (background-cycle beat age,
+the coordinator's missing-rank gather state). Bundles are produced:
+
+- by the **wedge watchdog** (``HOROVOD_WATCHDOG_SECS``): a daemon thread
+  that fires when the background cycle loop or an in-flight negotiation
+  stops beating for the threshold, bumping ``hvd_watchdog_fired_total``;
+- on **SIGUSR1** (dump and continue) and **SIGTERM** (dump, then chain
+  the previous handler / die) and on an uncaught exception
+  (``sys.excepthook``) — plus a final atexit dump if the watchdog ever
+  fired, so an externally killed wedged process still leaves evidence;
+- on demand via ``hvd.diagnose()``.
+
+Bundles land in ``HOROVOD_DIAG_DIR`` (default: the system temp dir) as
+``hvd_diag.rank{r}.{reason}.json`` and, in a launched job, are pushed to
+the launcher's KV store (scope ``diag/rank{k}``) so the rendezvous
+server's auth-exempt ``GET /debug`` can merge them and *name the wedged
+rank* (:func:`merge_bundles`). See docs/observability.md, "Debugging a
+hung job".
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import logging
+import os
+import signal
+import socket
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from ..common import env as env_schema
+from ..common import util as common_util
+from . import flightrec, lockcheck
+
+LOG = logging.getLogger("horovod_tpu")
+
+#: KV-store scope watchdog/crash dumps are pushed under (key "rank{k}");
+#: the rendezvous server's GET /debug reads the same scope back.
+KV_SCOPE = "diag"
+
+
+def watchdog_secs() -> float:
+    return env_schema.get_float(env_schema.HOROVOD_WATCHDOG_SECS, 0.0)
+
+
+def diag_dir() -> str:
+    return env_schema.get_str(env_schema.HOROVOD_DIAG_DIR) \
+        or tempfile.gettempdir()
+
+
+def _rank() -> int:
+    return env_schema.get_int(env_schema.HOROVOD_RANK, 0)
+
+
+# --------------------------------------------------------------------------
+# Live-state probes: subsystems register callables returning JSON-able
+# dicts (BackgroundRuntime registers cycle state, the coordinator its
+# gather state) so the bundle sees runtime internals without diag
+# importing ops/ (no import cycles). Every probe is best-effort.
+# --------------------------------------------------------------------------
+
+_PROBES: Dict[str, Callable[[], dict]] = {}
+_probes_lock = threading.Lock()
+
+
+def register_probe(name: str, fn: Callable[[], dict]) -> None:
+    with _probes_lock:
+        _PROBES[name] = fn
+
+
+def unregister_probe(name: str) -> None:
+    with _probes_lock:
+        _PROBES.pop(name, None)
+
+
+def thread_stacks() -> List[dict]:
+    """Every live thread's current stack, watchdog-safe: reads
+    ``sys._current_frames()`` without stopping the world."""
+    threads = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        t = threads.get(ident)
+        out.append({
+            "thread_id": ident,
+            "name": t.name if t is not None else "?",
+            "daemon": bool(t.daemon) if t is not None else None,
+            "stack": "".join(traceback.format_stack(frame)),
+        })
+    return out
+
+
+def build_bundle(reason: str, last_events: int = 200,
+                 stall: Optional[dict] = None) -> dict:
+    """The local diagnostic bundle (``hvd.diagnose()`` returns this)."""
+    from . import metrics as metrics_mod
+
+    bundle = {
+        "reason": reason,
+        "rank": _rank(),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "time_unix": time.time(),
+        "time_monotonic": time.monotonic(),
+        "threads": thread_stacks(),
+        "lockcheck": lockcheck.report(),
+        "metrics": metrics_mod.get_registry().snapshot(),
+    }
+    if stall:
+        bundle["stall"] = stall
+    recorder = flightrec.get_recorder()
+    bundle["flight_events"] = [] if recorder is None \
+        else recorder.events(last=last_events)
+    try:
+        from . import tracing as tracing_mod
+
+        tracer = tracing_mod.get_tracer()
+        if tracer is not None:
+            bundle["trace"] = {"open_spans": tracer.open_spans(),
+                               "report": tracer.report()}
+    except Exception as e:  # tracing must never block a dump
+        bundle["trace"] = {"error": repr(e)}
+    probes = {}
+    with _probes_lock:
+        items = list(_PROBES.items())
+    for name, fn in items:
+        try:
+            probes[name] = fn()
+        except Exception as e:
+            probes[name] = {"error": repr(e)}
+    bundle["probes"] = probes
+    return bundle
+
+
+# Launcher KV client for watchdog/crash pushes. A dedicated client (not
+# the MetricsDumper's): pushes fire from the watchdog/signal context
+# concurrently with the dumper's cadence, and the HTTP client's
+# keep-alive socket is not shareable across threads.
+_kv_client = None
+
+
+def set_kv_client(client) -> None:
+    global _kv_client
+    _kv_client = client
+
+
+def bundle_path(reason: str, rank: Optional[int] = None) -> str:
+    if rank is None:
+        rank = _rank()
+    return os.path.join(diag_dir(), f"hvd_diag.rank{rank}.{reason}.json")
+
+
+def dump_bundle(reason: str, push: bool = True,
+                stall: Optional[dict] = None) -> str:
+    """Build + write (atomically) + best-effort KV-push one bundle.
+
+    Returns the file path ("" if the write failed). Never raises:
+    diagnostics taking down the job they are diagnosing is the one
+    unforgivable failure mode here.
+    """
+    try:
+        bundle = build_bundle(reason, stall=stall)
+    except Exception:
+        LOG.exception("diag: bundle build failed")
+        return ""
+    path = bundle_path(reason, bundle["rank"])
+    payload = json.dumps(bundle, default=repr).encode()
+    try:
+        common_util.atomic_write_bytes(path, payload)
+    except Exception as e:
+        LOG.warning("diag: bundle write to %s failed: %s", path, e)
+        path = ""
+    if push and _kv_client is not None:
+        try:
+            _kv_client.put(KV_SCOPE, f"rank{bundle['rank']}", payload)
+        except Exception as e:
+            LOG.debug("diag: bundle KV push failed: %s", e)
+    flightrec.note("diag_dump", reason=reason, path=path)
+    return path
+
+
+# --------------------------------------------------------------------------
+# Wedge watchdog
+# --------------------------------------------------------------------------
+
+class Watchdog(threading.Thread):
+    """Daemon thread that dumps diagnostics when progress stops.
+
+    The watched loop calls :meth:`beat` once per cycle; long blocking
+    sections bracket themselves with :meth:`enter`/:meth:`exit_phase` so
+    a fire can say *which* phase wedged (e.g. ``negotiate``). One fire
+    per wedge: the fired latch clears on the next beat, so a 10-minute
+    hang produces one bundle, not one per poll.
+    """
+
+    def __init__(self, threshold_s: float,
+                 dump: Callable[..., str] = dump_bundle):
+        super().__init__(daemon=True, name="hvd-watchdog")
+        self.threshold_s = float(threshold_s)
+        self._dump = dump
+        self._stop_ev = threading.Event()
+        self._lock = lockcheck.make_lock("diag.watchdog")
+        self._last_beat = time.monotonic()  # guarded-by: _lock
+        self._phase = ""
+        self._phase_since = 0.0
+        self._fired = False
+        self.fired_count = 0
+        self._metric = None  # lazy: zero hvd_watchdog_* series until a fire
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._fired = False
+
+    def enter(self, phase: str) -> None:
+        with self._lock:
+            self._phase = phase
+            self._phase_since = time.monotonic()
+            self._last_beat = self._phase_since
+            self._fired = False  # reaching enter() IS progress: re-arm
+
+    def exit_phase(self, phase: str) -> None:
+        with self._lock:
+            if self._phase == phase:
+                self._phase = ""
+            self._last_beat = time.monotonic()
+            self._fired = False
+
+    def state(self) -> dict:
+        """Probe payload: the current stall phase and beat age."""
+        with self._lock:
+            return {"phase": self._phase,
+                    "age_s": time.monotonic() - self._last_beat,
+                    "threshold_s": self.threshold_s,
+                    "fired_count": self.fired_count}
+
+    def run(self) -> None:
+        poll = max(min(self.threshold_s / 4.0, 1.0), 0.05)
+        while not self._stop_ev.wait(poll):
+            with self._lock:
+                age = time.monotonic() - self._last_beat
+                phase = self._phase
+                fire = not self._fired and age >= self.threshold_s
+                if fire:
+                    self._fired = True
+                    self.fired_count += 1
+            if fire:
+                self._fire(phase, age)
+
+    def _fire(self, phase: str, age: float) -> None:
+        if self._metric is None:
+            from . import metrics as metrics_mod
+
+            self._metric = metrics_mod.get_registry().counter(
+                "hvd_watchdog_fired_total",
+                "wedge-watchdog diagnostics dumps")
+        self._metric.inc()
+        flightrec.note("watchdog", phase=phase, age_s=round(age, 3))
+        LOG.warning(
+            "watchdog: no progress for %.1f s (threshold %.1f s, phase %r)"
+            " — dumping diagnostics", age, self.threshold_s, phase or "idle")
+        try:
+            self._dump("watchdog", stall={"phase": phase,
+                                          "age_s": round(age, 3)})
+        except Exception:
+            LOG.exception("watchdog: diagnostics dump failed")
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self.is_alive():
+            self.join(timeout=5)
+
+
+_WATCHDOG: Optional[Watchdog] = None
+
+
+def get_watchdog() -> Optional[Watchdog]:
+    return _WATCHDOG
+
+
+def init_watchdog(threshold_s: Optional[float] = None) -> Optional[Watchdog]:
+    """Start the process watchdog when ``HOROVOD_WATCHDOG_SECS`` > 0
+    (idempotent); returns None when disabled."""
+    global _WATCHDOG
+    if threshold_s is None:
+        threshold_s = watchdog_secs()
+    if threshold_s <= 0:
+        return _WATCHDOG
+    if _WATCHDOG is None:
+        _WATCHDOG = Watchdog(threshold_s)
+        _WATCHDOG.start()
+    return _WATCHDOG
+
+
+def reset_watchdog() -> None:
+    global _WATCHDOG
+    wd = _WATCHDOG
+    _WATCHDOG = None
+    if wd is not None:
+        wd.stop()
+
+
+# --------------------------------------------------------------------------
+# Signal / crash / exit hooks
+# --------------------------------------------------------------------------
+
+_hooks_installed = False
+
+
+def install_crash_hooks() -> None:
+    """Wire the bundle dump to SIGUSR1 (dump, keep running), SIGTERM
+    (dump, then the previous disposition — the job still dies), uncaught
+    exceptions, and — iff the watchdog ever fired — process exit.
+
+    Installed from ``hvd.init()`` AFTER the fatal-exit hook
+    (common/context.py), so the excepthook chain runs dump-first, then
+    the rank's print-and-``os._exit``. Idempotent; best-effort on
+    platforms/threads where signal registration fails.
+    """
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    try:
+        faulthandler.enable()
+    except Exception:  # no usable stderr (embedded interpreters)
+        pass
+
+    def _handler(signum, frame, chain_prev=None):
+        name = signal.Signals(signum).name.lower()
+        dump_bundle(name)
+        if chain_prev is None:
+            return  # SIGUSR1: observe and continue
+        if callable(chain_prev):
+            chain_prev(signum, frame)
+        elif chain_prev != signal.SIG_IGN:
+            # restore the default disposition and re-deliver, so the
+            # process still dies of SIGTERM after leaving evidence
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    for signame, chains in (("SIGUSR1", False), ("SIGTERM", True)):
+        sig = getattr(signal, signame, None)
+        if sig is None:
+            continue
+        try:
+            prev = signal.getsignal(sig)
+            if chains:
+                signal.signal(sig, lambda n, f, p=prev: _handler(n, f, p))
+            else:
+                signal.signal(sig, _handler)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            pass
+
+    prev_hook = sys.excepthook
+
+    def _excepthook(etype, value, tb):
+        try:
+            dump_bundle("crash")
+        except Exception:
+            pass
+        prev_hook(etype, value, tb)
+
+    sys.excepthook = _excepthook
+
+    def _atexit_dump():
+        wd = _WATCHDOG
+        if wd is not None and wd.fired_count > 0:
+            # the run wedged at some point: leave a final-state bundle
+            # even if something later unstuck it or an outer kill landed
+            dump_bundle("exit", push=False)
+
+    atexit.register(_atexit_dump)
+
+
+def reset_crash_hooks_for_tests() -> None:
+    """Allow a test subprocess to re-install hooks (NOT an uninstall)."""
+    global _hooks_installed
+    _hooks_installed = False
+
+
+# --------------------------------------------------------------------------
+# Cross-rank merge (rendezvous server's GET /debug)
+# --------------------------------------------------------------------------
+
+def merge_bundles(bundles: Dict[int, dict]) -> dict:
+    """Merge per-rank bundles into one attribution view.
+
+    Suspect naming, strongest signal first: (1) the union of
+    ``missing_ranks`` from any coordinator gather probe — the ranks the
+    coordinator was still waiting on are the wedge by definition;
+    (2) otherwise the rank with the largest watchdog stall age.
+    """
+    ranks: Dict[str, dict] = {}
+    missing: set = set()
+    worst_age, worst_rank = -1.0, None
+    for rank, b in sorted(bundles.items()):
+        if not isinstance(b, dict):
+            continue
+        stall = b.get("stall") or {}
+        probes = b.get("probes") or {}
+        coord = probes.get("coordinator") or {}
+        info = {
+            "reason": b.get("reason"),
+            "hostname": b.get("hostname"),
+            "time_unix": b.get("time_unix"),
+            "stall": stall,
+            "threads": len(b.get("threads") or ()),
+            "flight_events": len(b.get("flight_events") or ()),
+            "open_spans": (b.get("trace") or {}).get("open_spans"),
+            "coordinator": coord or None,
+        }
+        ranks[str(rank)] = info
+        for m in coord.get("missing_ranks") or ():
+            try:
+                missing.add(int(m))
+            except (TypeError, ValueError):
+                pass
+        try:
+            age = float(stall.get("age_s", -1.0))
+        except (TypeError, ValueError):
+            age = -1.0
+        if age > worst_age:
+            worst_age, worst_rank = age, rank
+    if missing:
+        return {"ranks": ranks, "suspects": sorted(missing),
+                "attribution": "coordinator gather: ranks never submitted"}
+    if worst_rank is not None and worst_age >= 0:
+        return {"ranks": ranks, "suspects": [worst_rank],
+                "attribution": "largest watchdog stall age"}
+    return {"ranks": ranks, "suspects": [], "attribution": "none"}

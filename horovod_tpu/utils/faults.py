@@ -130,6 +130,9 @@ class _Rule:
                 "hvd_fault_injected_total", "chaos faults injected",
                 site=self.site, mode=self.mode)
         self._metric.inc()
+        from . import flightrec
+
+        flightrec.note("fault_injected", site=self.site, mode=self.mode)
 
     def fire(self):
         self.record()

@@ -377,9 +377,19 @@ class MetricsDumper:
                 from . import faults as faults_mod
 
                 faults_mod.fault_point("metrics.push")
+                # elastic-generation tag: the launcher's /metrics merge
+                # drops snapshots older than the newest (epoch, gen) seen,
+                # so ranks of a pre-resize generation stop reporting
+                # frozen counters (render_snapshots ignores extra keys)
+                from ..common import env as env_schema
+
+                snap = self.registry.snapshot()
+                snap["elastic_epoch"] = env_schema.get_int(
+                    env_schema.HOROVOD_ELASTIC_EPOCH, 0)
+                snap["elastic_gen"] = env_schema.get_int(
+                    env_schema.HOROVOD_ELASTIC_GEN, 0)
                 payload = faults_mod.corrupt(
-                    "metrics.push",
-                    json.dumps(self.registry.snapshot()).encode())
+                    "metrics.push", json.dumps(snap).encode())
                 self.kv_client.put(self.KV_SCOPE, f"rank{self.rank}",
                                    payload)
             except Exception as e:

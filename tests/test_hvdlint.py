@@ -45,6 +45,8 @@ def _project(**kw):
         "running.md": "| `HOROVOD_TRACE` | 0 | spans |",
         "observability.md": "hvd_good_total and hvd_dup_total",
     })
+    p.flight_categories = kw.get("flight_categories", {})
+    p.flight_category_dups = kw.get("flight_category_dups", [])
     return p
 
 
@@ -113,6 +115,51 @@ def test_metric_names_clean_when_documented():
     # non-hvd literals and dynamic names are out of scope
     assert _findings('reg.counter("python_info", "d")\n'
                      'reg.counter(name, "d")\n') == []
+
+
+def test_event_names_flags_undeclared_category():
+    proj = _project(flight_categories={"init_phase": 3})
+    got = _findings('note("bogus_event", x=1)\n', project=proj)
+    assert len(got) == 1 and got[0].rule == "event-names"
+    assert "bogus_event" in got[0].message
+    # attribute-style call sites (resolved recorder handles) are checked
+    # the same way as the module-level wrapper
+    got = _findings('self.recorder.note("also_bogus")\n', project=proj)
+    assert len(got) == 1 and "also_bogus" in got[0].message
+
+
+def test_event_names_clean_cases():
+    proj = _project(flight_categories={"init_phase": 3})
+    # declared categories and dynamic names are in scope / out of scope
+    assert _findings('rec.note("init_phase", phase="x")\n',
+                     project=proj) == []
+    assert _findings("note(category, x=1)\n", project=proj) == []
+    # other note()-named methods with >1 word are still only matched on
+    # the exact name "note" — note_straggler etc. stay untouched
+    assert _findings('insp.note_straggler("grad/w", 1, 0.5)\n',
+                     project=proj) == []
+    # without a loaded registry (synthetic default) the rule stands down
+    assert _findings('note("bogus_event")\n') == []
+
+
+def test_event_names_finalize_registry_contract():
+    from tools.hvdlint.rules import EventNamesRule
+
+    rule = EventNamesRule()
+    bad = _project(
+        flight_categories={"BadCase": 4, "ok_name": 5,
+                           "undocumented_cat": 6},
+        flight_category_dups=["ok_name"],
+        docs={"observability.md": "BadCase and ok_name"})
+    msgs = [f.message for f in rule.finalize(bad)]
+    assert any("snake_case" in m and "BadCase" in m for m in msgs)
+    assert any("more than once" in m and "ok_name" in m for m in msgs)
+    assert any("undocumented_cat" in m and "observability.md" in m
+               for m in msgs)
+    assert len(msgs) == 3
+    clean = _project(flight_categories={"ok_name": 5},
+                     docs={"observability.md": "the ok_name event"})
+    assert list(rule.finalize(clean)) == []
 
 
 def test_fault_sites_flags_undeclared_site_and_spec():
@@ -210,7 +257,7 @@ def test_package_clean():
     every invariant (env schema, metric docs, fault sites, zero-cost
     hooks, guarded-by, wire clocks) enforced going forward."""
     rules = make_rules()
-    assert len(rules) >= 6
+    assert len(rules) >= 7
     paths = [os.path.join(_REPO, p)
              for p in ("horovod_tpu", "tests", "benchmarks", "tools")]
     findings = run_lint(paths, root=_REPO, rules=rules)

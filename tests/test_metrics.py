@@ -272,6 +272,43 @@ def test_metrics_endpoint_merges_pushed_worker_snapshots():
     assert _parse_samples(text)['hvd_push_probe_total{rank="3"}'] == 11
 
 
+def test_metrics_merge_drops_stale_generation_snapshots(monkeypatch):
+    """Metrics continuity across elastic restarts: every push is tagged
+    with (elastic_epoch, elastic_gen); the scrape keeps only the newest
+    generation, so a removed rank's ghost series stops haunting the
+    endpoint after a reset (regression for exactly that)."""
+    monkeypatch.delenv("HOROVOD_ELASTIC_EPOCH", raising=False)
+    monkeypatch.delenv("HOROVOD_ELASTIC_GEN", raising=False)
+    srv = RendezvousServer(secret_key="gen-secret")
+    port = srv.start()
+    try:
+        kv = KVStoreClient("127.0.0.1", port, secret_key="gen-secret")
+        reg0 = mm.MetricsRegistry()
+        reg0.counter("hvd_push_probe_total").inc(5)
+        mm.MetricsDumper(reg0, kv_client=kv, rank=0).flush()
+        reg1 = mm.MetricsRegistry()
+        reg1.counter("hvd_push_probe_total").inc(7)
+        mm.MetricsDumper(reg1, kv_client=kv, rank=1).flush()
+        both = _parse_samples(_scrape(port))
+        assert both['hvd_push_probe_total{rank="0"}'] == 5
+        assert both['hvd_push_probe_total{rank="1"}'] == 7
+
+        # the runtime bumps the generation on an in-process reinit; the
+        # surviving rank 0 re-pushes, the removed rank 1 never does
+        monkeypatch.setenv("HOROVOD_ELASTIC_GEN", "2")
+        reg2 = mm.MetricsRegistry()
+        reg2.counter("hvd_push_probe_total").inc(9)
+        mm.MetricsDumper(reg2, kv_client=kv, rank=0).flush()
+        text = _scrape(port)
+    finally:
+        srv.stop()
+    _check_exposition(text)
+    s = _parse_samples(text)
+    assert s['hvd_push_probe_total{rank="0"}'] == 9
+    # rank 1's generation-(0,0) snapshot is stale: dropped, not merged
+    assert 'hvd_push_probe_total{rank="1"}' not in s
+
+
 # ---------------------------------------------------------------------------
 # stall inspector: gauges, warning message, warning -> shutdown escalation
 # ---------------------------------------------------------------------------

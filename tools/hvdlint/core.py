@@ -30,6 +30,7 @@ PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 # HOROVOD_* literals
 ENV_SCHEMA_REL = "horovod_tpu/common/env.py"
 FAULTS_REL = "horovod_tpu/utils/faults.py"
+FLIGHTREC_REL = "horovod_tpu/utils/flightrec.py"
 
 
 @dataclasses.dataclass
@@ -97,6 +98,30 @@ def _env_constant_lines(tree: ast.Module) -> Dict[str, int]:
     return out
 
 
+def _flight_categories(tree: ast.Module) -> "tuple[Dict[str, int], List[str]]":
+    """The declared ``CATEGORIES`` registry in utils/flightrec.py: a
+    tuple of (name, meaning) 2-tuples. Returns (name -> declaration line,
+    duplicate names in declaration order)."""
+    names: Dict[str, int] = {}
+    dups: List[str] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "CATEGORIES" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for row in node.value.elts:
+                if not (isinstance(row, (ast.Tuple, ast.List)) and row.elts):
+                    continue
+                head = row.elts[0]
+                if isinstance(head, ast.Constant) \
+                        and isinstance(head.value, str):
+                    if head.value in names:
+                        dups.append(head.value)
+                    else:
+                        names[head.value] = head.lineno
+    return names, dups
+
+
 def _fault_sites(tree: ast.Module) -> Set[str]:
     """The declared ``SITES`` tuple in utils/faults.py."""
     for node in tree.body:
@@ -124,6 +149,10 @@ class Project:
         self.env_constant_lines: Dict[str, int] = {}
         # declared fault sites from utils/faults.py SITES
         self.fault_sites: Set[str] = set()
+        # flight-recorder category -> declaration line, from the
+        # CATEGORIES registry in utils/flightrec.py (+ duplicate names)
+        self.flight_categories: Dict[str, int] = {}
+        self.flight_category_dups: List[str] = []
         # doc filename -> full text (for presence checks)
         self.docs: Dict[str, str] = {}
 
@@ -140,6 +169,11 @@ class Project:
         if os.path.exists(faults):
             with open(faults, encoding="utf-8") as f:
                 p.fault_sites = _fault_sites(ast.parse(f.read(), filename=faults))
+        flightrec = os.path.join(root, FLIGHTREC_REL)
+        if os.path.exists(flightrec):
+            with open(flightrec, encoding="utf-8") as f:
+                p.flight_categories, p.flight_category_dups = \
+                    _flight_categories(ast.parse(f.read(), filename=flightrec))
         for doc in ("running.md", "observability.md"):
             path = os.path.join(root, "docs", doc)
             if os.path.exists(path):

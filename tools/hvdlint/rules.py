@@ -11,9 +11,10 @@ import ast
 import re
 from typing import Dict, Iterable, List, Tuple
 
-from .core import ENV_SCHEMA_REL, FileContext, Finding, Project
+from .core import ENV_SCHEMA_REL, FLIGHTREC_REL, FileContext, Finding, Project
 
 METRIC_NAME_RE = re.compile(r"^hvd_[a-z0-9]+(_[a-z0-9]+)*$")
+EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 
 # modules that speak the negotiation wire format: timestamps that cross
@@ -120,6 +121,54 @@ class MetricNamesRule:
                               "docs/observability.md")
 
 
+class EventNamesRule:
+    """Every literal flight-recorder category passed to ``note()`` must
+    come from the CATEGORIES registry in utils/flightrec.py; registry
+    entries must be snake_case, unique, and documented in
+    docs/observability.md (the metric-names contract, for events)."""
+
+    name = "event-names"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        cats = ctx.project.flight_categories
+        if not cats:  # no registry loaded (synthetic project): stand down
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if fname != "note":
+                continue
+            cat = _str_const(node.args[0])
+            if cat is None or cat in cats:
+                continue
+            yield Finding(
+                self.name, ctx.path, node.lineno,
+                f"note() records undeclared flight-recorder category "
+                f"{cat!r}; declared categories: {', '.join(sorted(cats))}")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        for cat in project.flight_category_dups:
+            yield Finding(
+                self.name, FLIGHTREC_REL,
+                project.flight_categories.get(cat, 1),
+                f"flight-recorder category {cat!r} declared more than once "
+                "in CATEGORIES")
+        for cat, line in sorted(project.flight_categories.items()):
+            if not EVENT_NAME_RE.match(cat):
+                yield Finding(
+                    self.name, FLIGHTREC_REL, line,
+                    f"flight-recorder category {cat!r} is not snake_case "
+                    "(expected ^[a-z][a-z0-9_]*$)")
+            if not project.doc_mentions("observability.md", cat):
+                yield Finding(
+                    self.name, FLIGHTREC_REL, line,
+                    f"flight-recorder category {cat!r} is not documented "
+                    "in docs/observability.md")
+
+
 class FaultSitesRule:
     """Fault sites armed anywhere (package or tests) — fault_point()/
     corrupt() calls and literal HOROVOD_FAULT_SPEC values — must name a
@@ -180,7 +229,8 @@ class FaultSitesRule:
 # terminal identifiers that mark a "feature handle" guard: the zero-cost
 # contract says a disabled tracer/timeline/fault state costs one is-None
 # check, so nothing may allocate or read clocks before that check
-_GUARD_SUFFIXES = ("tracer", "timeline", "span", "auditor")
+_GUARD_SUFFIXES = ("tracer", "timeline", "span", "auditor", "recorder",
+                   "watchdog")
 _GUARD_NAMES = {"st", "state", "tl"}
 
 
@@ -369,6 +419,7 @@ def make_rules() -> List:
     return [
         EnvDisciplineRule(),
         MetricNamesRule(),
+        EventNamesRule(),
         FaultSitesRule(),
         ZeroCostHooksRule(),
         LockDisciplineRule(),
