@@ -409,6 +409,19 @@ def main():
     extras["allreduce_gbps_semantics"] = (
         "wire bytes (hvd_allreduce_bytes_total delta / wall time); the "
         "compressed config therefore reports post-compression bytes")
+    # ZeRO-1 sharded-update telemetry (docs/sharded_optimizer.md). The
+    # zero-cost contract says these series do not exist while the mode is
+    # off, so absent/zero reads report None rather than a misleading 0 —
+    # benchmarks/sharded_update.py is the dedicated A/B microbench.
+    _sh_wire = _reg.counter_value("hvd_sharded_update_wire_bytes_total")
+    extras["sharded_update_wire_bytes"] = int(_sh_wire) if _sh_wire else None
+    _sh_hits = _reg.counter_value("hvd_sharded_plan_hits_total")
+    _sh_total = _sh_hits + _reg.counter_value("hvd_sharded_plan_misses_total")
+    extras["sharded_plan_hit_rate"] = (
+        round(_sh_hits / _sh_total, 4) if _sh_total else None)
+    extras["sharded_shard_fraction"] = next(
+        (round(g["value"], 4) for g in hvd.metrics_snapshot()["gauges"]
+         if g["name"] == "hvd_sharded_update_shard_fraction"), None)
     # per-span lifecycle summary when HOROVOD_TRACE is on (docs/timeline.md):
     # where did the eager sub-benchmarks' collectives spend their time, and
     # did the coordinator attribute any straggling?

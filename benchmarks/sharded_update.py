@@ -1,0 +1,168 @@
+"""CPU microbench: replicated vs ZeRO-1 sharded weight update.
+
+Runs a simulated N-rank world in one process (opt/sharded.py
+``make_simulated_engines`` / ``simulated_step`` — the same compiled
+pack → reduce-scatter → update → allgather plan chain the real engine
+replays) against the classic replicated update (allreduce every
+gradient, every rank repeats the full optimizer step), and reports:
+
+- per-rank *update-path* wire bytes per step for both modes and their
+  ratio (``update_wire_reduction_x``). Ring accounting: the replicated
+  allreduce is an RS phase plus an AG phase of the gradient buffer,
+  2·(N-1)/N·B; the sharded path reduce-scatters only, (N-1)/N·B —
+  exactly 2× at any N for the sharded fraction. The parameter
+  allgather that replaces the second phase is reported separately
+  (``param_allgather_wire_bytes``): total step bytes are unchanged,
+  the win is *where* they sit (docs/sharded_optimizer.md).
+- ms/step for both modes (CPU lockstep simulation — plan replay
+  overhead and update math, not chip numbers).
+- sharded-plan cache hit rate over the measured window (1.0 after
+  warmup — every step replays cached programs).
+- per-rank optimizer-state bytes for both modes (the ZeRO-1 ledger:
+  sharded ≈ replicated/N plus the replicated-leaf remainder).
+
+Prints ONE JSON line; ``measure()`` is importable (tier-1 smoke test
+tests/test_sharded_update.py::test_microbench_smoke).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu.opt import sharded as sharded_mod
+from horovod_tpu.utils import metrics as metrics_mod
+
+WIRE_SEMANTICS = (
+    "ring accounting, per rank: replicated update path = RS + AG phases "
+    "of the gradient buffer = 2*(N-1)/N*B; sharded update path = RS only "
+    "= (N-1)/N*B (sub-threshold leaves still allreduce). The parameter "
+    "allgather is accounted separately — total step bytes are unchanged, "
+    "the gradient/update path halves.")
+
+
+def _demo_params(key=0):
+    """Mixed pytree: two shardable fp32 mats, sub-threshold bias/scalar
+    leaves that must stay on the classic allreduce path."""
+    rngs = jax.random.split(jax.random.PRNGKey(key), 4)
+    return {
+        "dense1": {"w": jax.random.normal(rngs[0], (256, 256), jnp.float32),
+                   "b": jnp.zeros((256,), jnp.float32)},
+        "dense2": {"w": jax.random.normal(rngs[1], (256, 128), jnp.float32),
+                   "b": jnp.zeros((128,), jnp.float32)},
+        "emb": jax.random.normal(rngs[2], (128, 256), jnp.float32),
+        "scale": jnp.float32(1.0),
+    }
+
+
+def _tree_bytes(tree) -> int:
+    return int(sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree)
+                   if hasattr(x, "dtype")))
+
+
+def _grads_per_rank(params, world: int, step: int):
+    return [jax.tree.map(
+        lambda p, r=r: jnp.asarray(
+            np.random.RandomState(1000 * step + r).standard_normal(p.shape),
+            p.dtype), params) for r in range(world)]
+
+
+def _phase_bytes() -> dict:
+    out = {}
+    for c in metrics_mod.get_registry().snapshot()["counters"]:
+        if c["name"] == "hvd_sharded_update_wire_bytes_total":
+            out[c["labels"].get("phase", "")] = float(c["value"])
+    return out
+
+
+def _plan_counts() -> tuple:
+    reg = metrics_mod.get_registry()
+    return (reg.counter_value("hvd_sharded_plan_hits_total"),
+            reg.counter_value("hvd_sharded_plan_misses_total"))
+
+
+def _sync(tree) -> None:
+    jax.block_until_ready(jax.tree.leaves(tree))
+
+
+def measure(world: int = 2, steps: int = 10, warmup: int = 3,
+            optimizer=None) -> dict:
+    """Run the A/B and return the result dict (see module docstring)."""
+    opt = optimizer or optax.adam(1e-3)
+    params = _demo_params()
+    total_bytes = _tree_bytes(params)
+
+    # --- replicated baseline: stacked-mean reduce + full step per rank ---
+    rep_step = jax.jit(lambda p, stacks, s: (
+        lambda g: (lambda u, ns: (optax.apply_updates(p, u), ns))
+        (*opt.update(g, s, p)))(
+            jax.tree.map(lambda st: jnp.mean(st, axis=0), stacks)))
+    rep_state = opt.init(params)
+    rp = params
+    for i in range(warmup):
+        stacks = jax.tree.map(lambda *g: jnp.stack(g),
+                              *_grads_per_rank(params, world, i))
+        rp, rep_state = rep_step(rp, stacks, rep_state)
+    _sync(rp)
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        stacks = jax.tree.map(lambda *g: jnp.stack(g),
+                              *_grads_per_rank(params, world, i))
+        rp, rep_state = rep_step(rp, stacks, rep_state)
+    _sync(rp)
+    replicated_ms = (time.perf_counter() - t0) / steps * 1e3
+    scale = (world - 1) / world if world > 1 else 0.0
+    replicated_update_bytes = 2 * scale * total_bytes
+
+    # --- sharded: lockstep simulated world over the compiled plans -------
+    engines = sharded_mod.make_simulated_engines(opt, world)
+    states = [e.init(params) for e in engines]
+    layout = engines[0].layout
+    sp = params
+    for i in range(warmup):
+        sp, states = sharded_mod.simulated_step(
+            engines, sp, _grads_per_rank(params, world, i), states)
+    _sync(sp)
+    b0, (h0, m0) = _phase_bytes(), _plan_counts()
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        sp, states = sharded_mod.simulated_step(
+            engines, sp, _grads_per_rank(params, world, i), states)
+    _sync(sp)
+    sharded_ms = (time.perf_counter() - t0) / steps * 1e3
+    b1, (h1, m1) = _phase_bytes(), _plan_counts()
+    # counters accumulate across all N engines: divide per step per rank
+    per_rank = lambda phase: (  # noqa: E731
+        (b1.get(phase, 0.0) - b0.get(phase, 0.0)) / steps / world)
+    sharded_update_bytes = per_rank("reduce_scatter") + per_rank("allreduce")
+    lookups = (h1 - h0) + (m1 - m0)
+    state_rep = _tree_bytes(rep_state)
+    state_shard = _tree_bytes(states[0])
+    return {
+        "world": world,
+        "steps": steps,
+        "replicated_ms_per_step": round(replicated_ms, 3),
+        "sharded_ms_per_step": round(sharded_ms, 3),
+        "ms_semantics": "CPU lockstep simulation: sharded_ms covers all "
+                        f"{world} virtual ranks' plan replays in one "
+                        "process — compare shapes, not absolutes",
+        "update_wire_bytes_replicated": int(replicated_update_bytes),
+        "update_wire_bytes_sharded": int(sharded_update_bytes),
+        "update_wire_reduction_x": (
+            round(replicated_update_bytes / sharded_update_bytes, 3)
+            if sharded_update_bytes else None),
+        "param_allgather_wire_bytes": int(per_rank("allgather")),
+        "wire_semantics": WIRE_SEMANTICS,
+        "plan_hit_rate": round((h1 - h0) / lookups, 4) if lookups else None,
+        "shard_fraction": round(layout.shard_fraction, 4),
+        "state_bytes_replicated": state_rep,
+        "state_bytes_sharded_per_rank": state_shard,
+        "state_ratio": round(state_shard / state_rep, 4) if state_rep else None,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure()))

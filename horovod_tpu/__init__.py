@@ -254,6 +254,9 @@ def broadcast_parameters(params, root_rank: int = 0,
 from .opt import (  # noqa: E402,F401
     DistributedOptimizer,
     DistributedGradientTransformation,
+    ShardedDistributedOptimizer,
+    ShardedUpdateEngine,
     cross_replica_sharded_optimizer,
     distributed_grad,
+    plan_shard_layout,
 )

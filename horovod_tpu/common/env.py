@@ -82,6 +82,12 @@ HOROVOD_COMPILE_CACHE = "HOROVOD_COMPILE_CACHE"
 # master switch and the held-too-long warning threshold in milliseconds
 HOROVOD_LOCKCHECK = "HOROVOD_LOCKCHECK"
 HOROVOD_LOCKCHECK_HOLD_MS = "HOROVOD_LOCKCHECK_HOLD_MS"
+# ZeRO-1 sharded weight update (opt/sharded.py; docs/sharded_optimizer.md):
+# master switch for the reduce-scatter → sharded step → allgather path in
+# the framework shims, and the replicate threshold in elements below which
+# a leaf stays on the classic allreduce path
+HOROVOD_SHARDED_UPDATE = "HOROVOD_SHARDED_UPDATE"
+HOROVOD_SHARDED_MIN_ELEMS = "HOROVOD_SHARDED_MIN_ELEMS"
 # native-core sanitizer build: address|thread adds the matching
 # -fsanitize flags to the on-demand g++ build (_native/__init__.py)
 HOROVOD_NATIVE_SANITIZE = "HOROVOD_NATIVE_SANITIZE"
@@ -192,6 +198,10 @@ class RuntimeConfig:
     # straggler attribution — off by default (zero-cost contract)
     trace_enabled: bool = False
     trace_buffer: int = 4096
+    # ZeRO-1 sharded weight update (opt/sharded.py) — off by default;
+    # the threshold mirrors sharding_policy.DEFAULT_MIN_SHARD_ELEMS
+    sharded_update: bool = False
+    sharded_min_elems: int = 2 ** 14
     # postmortem layer (utils/flightrec.py, utils/diag.py) — all off by
     # default (flight recorder zero-cost, watchdog thread not created)
     flightrec_enabled: bool = False
@@ -236,6 +246,9 @@ class RuntimeConfig:
         c.fused_plan_disable = get_bool(HOROVOD_FUSED_PLAN_DISABLE)
         c.trace_enabled = get_bool(HOROVOD_TRACE)
         c.trace_buffer = get_int(HOROVOD_TRACE_BUFFER, c.trace_buffer)
+        c.sharded_update = get_bool(HOROVOD_SHARDED_UPDATE)
+        c.sharded_min_elems = get_int(HOROVOD_SHARDED_MIN_ELEMS,
+                                      c.sharded_min_elems)
         c.flightrec_enabled = get_bool(HOROVOD_FLIGHTREC)
         c.flightrec_buffer = get_int(HOROVOD_FLIGHTREC_BUFFER,
                                      c.flightrec_buffer)

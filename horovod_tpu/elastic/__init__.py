@@ -45,13 +45,24 @@ def _reinitialize():
 
     from ..common import context as ctx_mod
     from ..common import env as env_schema
-    from ..ops.collectives import clear_eager_cache
+    from ..ops.collectives import clear_eager_cache, invalidate_fused_plans
 
     os.environ[env_schema.HOROVOD_ELASTIC_GEN] = str(
         int(os.environ.get(env_schema.HOROVOD_ELASTIC_GEN, "0")) + 1)
 
     ctx_mod.shutdown(drain=False)
+    # fused/sharded plans first, THROUGH the accounting path: the new
+    # generation's world may differ, so a replay would be a stale
+    # topology — the invalidation-reason counter and the flightrec
+    # breadcrumb must record that this was a deliberate drop, not LRU
+    # churn. clear_eager_cache() then wipes the plain programs silently.
+    invalidate_fused_plans()
     clear_eager_cache()
+    # sharded-update engines replan their layout (and re-materialize
+    # their state shard via load_full_state) under the new generation
+    from ..opt import sharded as sharded_mod
+
+    sharded_mod.notify_reshard()
     ctx_mod.init()
 
 

@@ -62,10 +62,19 @@ def DistributedOptimizer(optimizer, name=None, compression=None, op=None,
                          process_set=None,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = False,
-                         sparse_as_dense: bool = False):
+                         sparse_as_dense: bool = False,
+                         sharded_update=None):
     """Dynamic-subclass optimizer wrap (reference keras/__init__.py:40 →
     _keras/__init__.py:28-166). ``backward_passes_per_step > 1`` turns on
-    local gradient aggregation (reference gradient_aggregation.py)."""
+    local gradient aggregation (reference gradient_aggregation.py).
+
+    ``sharded_update`` (ZeRO-1) is not available for keras wrappers —
+    explicit True raises, the env knob warns once and is ignored; see
+    docs/sharded_optimizer.md for the JAX and torch paths that do
+    implement it."""
+    from horovod_tpu.tensorflow import _check_sharded_update
+
+    _check_sharded_update(sharded_update)
     return create_distributed_optimizer(
         optimizer, name=name, compression=compression, op=op,
         gradient_predivide_factor=gradient_predivide_factor,

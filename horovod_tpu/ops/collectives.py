@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import context as ctx_mod
+from ..common import env as env_schema
 from ..common.context import DEFAULT_AXIS, LOCAL_AXIS, PROC_AXIS, ProcessSet
 from ..common.exceptions import HorovodInternalError
 
@@ -158,6 +159,15 @@ def _plan_metrics():
                       "fused-chunk plans currently cached"),
         )
     return _plan_metric_handles
+
+
+def _plan_epoch() -> int:
+    """Elastic generation folded into every plan signature. A resize can
+    keep the process-set *name* ("global") while changing its world size,
+    so a plan keyed on name alone would replay a stale topology after
+    rejoin; the generation makes the stale key unreachable even if a
+    cache clear is ever skipped."""
+    return env_schema.get_int(env_schema.HOROVOD_ELASTIC_GEN, 0)
 
 
 def _cache_capacity() -> int:
@@ -545,7 +555,10 @@ def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
         return None
     nproc = ps.cross_size
     hier = nproc > 1 and _allreduce_hier(op, ps, nproc)
-    key = (_PLAN_KEY, "allreduce", ps.name, tuple(names), tuple(shapes),
+    # nproc + elastic generation in the signature: an elastic resize can
+    # reuse the set name with a different world size (see _plan_epoch)
+    key = (_PLAN_KEY, "allreduce", ps.name, nproc, _plan_epoch(),
+           tuple(names), tuple(shapes),
            str(dtype), int(op), float(prescale_factor),
            float(postscale_factor), bool(on_device), hier)
     m = _plan_metrics()
@@ -564,6 +577,145 @@ def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
     _evict_over_capacity()
     m[4].set(_plan_count)
     return plan
+
+
+# ===========================================================================
+# Sharded-update plans (ZeRO-1, opt/sharded.py) — the pack → reduce-scatter
+# → sharded step → allgather → unpack steady state as cached programs
+# ===========================================================================
+#
+# Three compiled stages per dtype group, sharing the fused-plan LRU (keys
+# carry the _PLAN_KEY prefix so invalidate_fused_plans() and the capacity
+# eviction treat them exactly like allreduce chunk plans). The shard-layout
+# digest is part of every key: a layout rebuild (elastic resize, threshold
+# change) misses onto fresh programs instead of replaying a stale topology.
+# ``ps=None`` selects the simulated-world flavor (single process driving N
+# virtual ranks, tests/benchmarks): same programs, no process-axis sharding.
+
+_sharded_metric_handles = None
+
+
+def _sharded_metrics():
+    """(plan_hits, plan_misses) — resolved lazily on the first sharded
+    plan lookup, so the mode-off state registers no series."""
+    global _sharded_metric_handles
+    if _sharded_metric_handles is None:
+        from ..utils import metrics as metrics_mod
+
+        reg = metrics_mod.get_registry()
+        _sharded_metric_handles = (
+            reg.counter("hvd_sharded_plan_hits_total",
+                        "sharded-update plan cache hits"),
+            reg.counter("hvd_sharded_plan_misses_total",
+                        "sharded-update plans compiled (cache misses)"),
+        )
+    return _sharded_metric_handles
+
+
+def _sharded_plan(key, builder):
+    """Fused-plan cache front end for the sharded-update stages: same LRU
+    and invalidation machinery as ``fused_chunk_plan``, separate hit/miss
+    series so the bench can report the sharded steady state on its own."""
+    global _plan_count
+    m = _sharded_metrics()
+    plan = _EAGER_CACHE.get(key)
+    if plan is not None:
+        _EAGER_CACHE.move_to_end(key)
+        m[0].inc()
+        return plan
+    m[1].inc()
+    plan = builder()
+    _EAGER_CACHE[key] = plan
+    _plan_count += 1
+    _evict_over_capacity()
+    _plan_metrics()[4].set(_plan_count)
+    return plan
+
+
+def _sharded_ps_name(ps: Optional[ProcessSet]) -> str:
+    return "simulated" if ps is None else ps.name
+
+
+def sharded_pack_plan(ps: Optional[ProcessSet], world: int, sizes, shapes,
+                      dtype, shard_elems: int, digest: str):
+    """Compiled ``(*leaves) -> flat[world*shard_elems]``: ravel each leaf,
+    cast to the group dtype, concatenate, zero-pad to the world-divisible
+    extent the layout chose."""
+    sizes = tuple(int(s) for s in sizes)
+    shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+    key = (_PLAN_KEY, "sharded_pack", _sharded_ps_name(ps), int(world),
+           _plan_epoch(), sizes, shapes, str(dtype), int(shard_elems), digest)
+
+    def build():
+        padded = int(world) * int(shard_elems)
+        total = sum(sizes)
+
+        def pack(*leaves):
+            flat = [jnp.ravel(x).astype(dtype) for x in leaves]
+            cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+            if padded > total:
+                cat = jnp.pad(cat, (0, padded - total))
+            return cat
+
+        return jax.jit(pack)
+
+    return _sharded_plan(key, build)
+
+
+def sharded_reduce_scatter_plan(ps: Optional[ProcessSet], world: int,
+                                rank: int, op, shard_elems: int, dtype,
+                                digest: str, prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0):
+    """Compiled ``G[world, world*shard_elems] -> shard[shard_elems]``:
+    reduce over the contributor axis, keep only this rank's contiguous
+    shard. The wire analogue of a ring reduce-scatter — (world-1)/world
+    of the padded buffer crosses the wire, half an allreduce."""
+    key = (_PLAN_KEY, "sharded_rs", _sharded_ps_name(ps), int(world),
+           _plan_epoch(), int(rank), int(op), int(shard_elems), str(dtype),
+           float(prescale_factor), float(postscale_factor), digest)
+
+    def build():
+        body = _allreduce_body(ps, op, float(prescale_factor),
+                               float(postscale_factor), False)
+        lo = int(rank) * int(shard_elems)
+
+        def f(g):
+            return lax.slice(body(g), (lo,), (lo + int(shard_elems),))
+
+        if ps is not None:
+            return jax.jit(f, out_shardings=_replicated(ps))
+        return jax.jit(f)
+
+    return _sharded_plan(key, build)
+
+
+def sharded_allgather_plan(ps: Optional[ProcessSet], world: int, sizes,
+                           shapes, dtype, shard_elems: int, digest: str):
+    """Compiled ``S[world, shard_elems] -> per-leaf arrays``: flatten the
+    gathered shards back into the padded buffer, drop the pad, and
+    static-slice/reshape every leaf out — the allgather + unpack half of
+    the update, one program."""
+    sizes = tuple(int(s) for s in sizes)
+    shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+    key = (_PLAN_KEY, "sharded_ag", _sharded_ps_name(ps), int(world),
+           _plan_epoch(), sizes, shapes, str(dtype), int(shard_elems), digest)
+
+    def build():
+        def f(s):
+            flat = jnp.reshape(s, (int(world) * int(shard_elems),))
+            parts = []
+            off = 0
+            for n, shape in zip(sizes, shapes):
+                parts.append(jnp.reshape(
+                    lax.slice(flat, (off,), (off + n,)), shape))
+                off += n
+            return parts
+
+        if ps is not None:
+            return jax.jit(f, out_shardings=_replicated(ps))
+        return jax.jit(f)
+
+    return _sharded_plan(key, build)
 
 
 def _eager_allgather(x, ps: ProcessSet):

@@ -112,6 +112,9 @@ def DistributedGradientTransformation(
     backward_passes_per_step: int = 1,
     fuse_buckets: bool = True,
     average_aggregated_gradients: bool = True,
+    sharded_update: Optional[bool] = None,
+    num_shards: Optional[int] = None,
+    min_shard_elems: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are allreduced before update.
 
@@ -120,7 +123,33 @@ def DistributedGradientTransformation(
     are accumulated locally and only every Nth update triggers the
     collective + inner update (reference gradient_aggregation.py:16);
     intermediate steps return zero updates.
+
+    ``sharded_update`` (ZeRO-1, docs/sharded_optimizer.md): replace
+    allreduce + replicated step with reduce-scatter → sharded step →
+    allgather — optimizer state 1/N per chip. ``None`` defers to the
+    ``HOROVOD_SHARDED_UPDATE`` env knob; ``num_shards``/
+    ``min_shard_elems`` parameterize the layout planner.
     """
+    from . import sharded as sharded_mod
+
+    if sharded_update is None:
+        sharded_update = sharded_mod.sharded_update_enabled()
+    if sharded_update:
+        if backward_passes_per_step > 1:
+            raise ValueError(
+                "sharded_update does not compose with "
+                "backward_passes_per_step > 1 — accumulate outside the "
+                "optimizer (or run the replicated path)")
+        if compression is not None:
+            raise ValueError(
+                "sharded_update does not compose with gradient "
+                "compression (the reduce-scatter shard is never "
+                "materialized as a full tensor to compress)")
+        return sharded_mod.ShardedDistributedOptimizer(
+            optimizer, num_shards=num_shards, axis_name=axis_name, op=op,
+            min_shard_elems=min_shard_elems,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     n = backward_passes_per_step
 
     def init_fn(params):
@@ -322,3 +351,16 @@ def cross_replica_sharded_optimizer(inner: optax.GradientTransformation,
         return jax.tree.unflatten(treedef, out), _ShardedUpdate(new_inner)
 
     return optax.GradientTransformation(init, update)
+
+
+# ZeRO-1 sharded-update subsystem (docs/sharded_optimizer.md)
+from .sharded import (  # noqa: E402  (re-export after the core wrappers)
+    ShardGroup,
+    ShardLayout,
+    ShardedDistributedOptimizer,
+    ShardedUpdateEngine,
+    make_simulated_engines,
+    plan_shard_layout,
+    simulated_full_state,
+    simulated_step,
+)

@@ -28,21 +28,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-DEFAULT_MIN_SHARD_ELEMS = 2 ** 14  # 16k elems ≈ 64 KiB fp32
+from .sharding_policy import DEFAULT_MIN_SHARD_ELEMS, shard_dim
 
 
 def _leaf_spec(leaf, axis: str, min_shard_elems: int,
                axis_size: Optional[int]) -> P:
+    # dim choice shared with the ZeRO-1 planner (sharding_policy.py) so
+    # both sharding flavors agree on which leaves replicate
     shape = jnp.shape(leaf)
-    if not shape or leaf.size < min_shard_elems:
+    dim = shard_dim(shape, min_shard_elems=min_shard_elems,
+                    axis_size=axis_size)
+    if dim is None:
         return P()
-    # shard the largest dim that divides the axis size (even sharding —
-    # XLA handles padding, but even shards keep reduce_scatter exact)
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for i in order:
-        if axis_size is None or shape[i] % axis_size == 0:
-            return P(*(axis if j == i else None for j in range(len(shape))))
-    return P()
+    return P(*(axis if j == dim else None for j in range(len(shape))))
 
 
 def fsdp_specs(params, axis: str = "dp",

@@ -1,0 +1,92 @@
+"""Shared leaf-sharding policy.
+
+One place for the "should this leaf be sharded, and how" decision that
+was previously duplicated between the ZeRO-3 annotation path
+(``parallel/fsdp.py::_leaf_spec``) and the ZeRO-1 sharded-update planner
+(``opt/sharded.py``). Both consumers must agree: a leaf the FSDP
+annotator replicates (too small, no divisible dim) is exactly a leaf
+the update planner keeps on the classic allreduce path, so the
+replicate threshold and the dim-choice rule live here and nowhere else.
+
+Two granularities are exposed:
+
+- :func:`shard_dim` — per-leaf dimension choice (FSDP annotations and
+  any consumer that shards a leaf *in place*);
+- :func:`assign_owners` — whole-leaf owner assignment (the framework
+  shims that cannot slice a tensor across an optimizer step, e.g. the
+  torch ZeRO-1 mode, instead give each rank a disjoint subset of whole
+  leaves, balanced greedily by size).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+#: Replicate threshold: leaves below this many elements are not worth
+#: sharding — gathering a 1-KiB norm scale per layer costs more in
+#: collective latency than it saves in HBM. 16k elems ≈ 64 KiB fp32.
+DEFAULT_MIN_SHARD_ELEMS = 2 ** 14
+
+
+def _num_elems(shape: Sequence[int]) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def shard_dim(shape: Sequence[int], *,
+              min_shard_elems: int = DEFAULT_MIN_SHARD_ELEMS,
+              axis_size: Optional[int] = None) -> Optional[int]:
+    """The dimension index to shard ``shape`` over, or None to replicate.
+
+    Policy (extracted from fsdp.py's ``_leaf_spec``, pinned by
+    tests/test_sharded_update.py): scalars and leaves smaller than
+    ``min_shard_elems`` replicate; otherwise shard the largest dim that
+    divides ``axis_size`` (even sharding keeps reduce_scatter exact —
+    XLA would handle padding, but uneven shards never arise this way).
+    ``axis_size=None`` accepts any dim. No divisible dim → replicate.
+    """
+    shape = tuple(int(d) for d in shape)
+    if not shape or _num_elems(shape) < min_shard_elems:
+        return None
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if axis_size is None or shape[i] % axis_size == 0:
+            return i
+    return None
+
+
+def should_shard(shape: Sequence[int], *,
+                 min_shard_elems: int = DEFAULT_MIN_SHARD_ELEMS) -> bool:
+    """Whole-leaf variant of the same threshold: True when the leaf is
+    big enough to be worth moving off the replicated path. The ZeRO-1
+    planner flattens leaves, so only the element count matters — the
+    dim-divisibility clause of :func:`shard_dim` does not apply."""
+    shape = tuple(int(d) for d in shape)
+    return bool(shape) and _num_elems(shape) >= min_shard_elems
+
+
+def assign_owners(sizes: Sequence[int], world_size: int, *,
+                  min_shard_elems: int = DEFAULT_MIN_SHARD_ELEMS
+                  ) -> List[Optional[int]]:
+    """Greedy whole-leaf owner per entry of ``sizes`` (element counts).
+
+    Returns one entry per leaf: the owning rank, or None for leaves
+    below the replicate threshold (every rank updates those, the classic
+    path). Leaves are assigned largest-first to the least-loaded rank,
+    ties to the lowest rank — deterministic given (sizes, world_size,
+    min_shard_elems), which elastic relies on: every rank recomputes the
+    same assignment after a resize without communicating.
+    """
+    world_size = max(int(world_size), 1)
+    owners: List[Optional[int]] = [None] * len(sizes)
+    load = [0] * world_size
+    order = sorted(range(len(sizes)), key=lambda i: (-int(sizes[i]), i))
+    for i in order:
+        if int(sizes[i]) < min_shard_elems:
+            continue
+        rank = min(range(world_size), key=lambda r: (load[r], r))
+        owners[i] = rank
+        load[rank] += int(sizes[i])
+    return owners

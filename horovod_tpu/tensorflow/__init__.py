@@ -499,6 +499,34 @@ def DistributedGradientTape(gradtape, device_dense="", device_sparse="",
 # DistributedOptimizer (reference tensorflow/__init__.py:599)
 # ---------------------------------------------------------------------------
 
+_warned_sharded_env = False
+
+
+def _check_sharded_update(sharded_update):
+    """Support-matrix gate for the ZeRO-1 mode (see DistributedOptimizer's
+    docstring): explicit True is a hard error, the env knob only warns."""
+    global _warned_sharded_env
+    if sharded_update:
+        raise ValueError(
+            "sharded_update (ZeRO-1) is not supported for TF/keras "
+            "optimizers; use horovod_tpu.DistributedGradientTransformation"
+            "(..., sharded_update=True) for JAX/optax or "
+            "horovod_tpu.torch.DistributedOptimizer(..., "
+            "sharded_update=True) for torch (docs/sharded_optimizer.md)")
+    if sharded_update is None and not _warned_sharded_env:
+        from horovod_tpu.opt.sharded import sharded_update_enabled
+
+        if sharded_update_enabled():
+            import logging
+
+            _warned_sharded_env = True
+            logging.getLogger("horovod_tpu").warning(
+                "HOROVOD_SHARDED_UPDATE is set but the TF/keras "
+                "DistributedOptimizer does not implement the sharded "
+                "update path; continuing with the replicated update "
+                "(see docs/sharded_optimizer.md for supported frameworks)")
+
+
 def DistributedOptimizer(optimizer, name=None, use_locking=False,
                          device_dense="", device_sparse="",
                          compression=Compression.none,
@@ -506,12 +534,24 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                          backward_passes_per_step=1, op=Average,
                          gradient_predivide_factor=1.0,
                          average_aggregated_gradients=False,
-                         process_set: Optional[ProcessSet] = None):
+                         process_set: Optional[ProcessSet] = None,
+                         sharded_update: Optional[bool] = None):
     """Wrap a TF optimizer so gradients are allreduced before being
     applied. Keras (2/3) optimizers go through the shared keras wrapper
     (reference defers the same way, tensorflow/__init__.py:679-698); legacy
     ``tf.compat.v1.train.Optimizer`` gets its ``compute_gradients``
-    intercepted."""
+    intercepted.
+
+    ``sharded_update`` (ZeRO-1) is not implemented for the TF/keras
+    wrappers — the apply path runs inside ``tf.function`` graphs this
+    shim does not own, so there is no seam to split the step across
+    ranks. Passing ``sharded_update=True`` raises; the
+    ``HOROVOD_SHARDED_UPDATE`` env knob is ignored here (one warning)
+    so a job-wide knob doesn't break keras entry points. Use the JAX
+    ``hvd.DistributedGradientTransformation(..., sharded_update=True)``
+    or the torch ``hvd.torch.DistributedOptimizer(...,
+    sharded_update=True)`` paths instead (docs/sharded_optimizer.md)."""
+    _check_sharded_update(sharded_update)
     import keras
 
     if isinstance(optimizer, keras.optimizers.Optimizer):

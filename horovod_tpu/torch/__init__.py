@@ -693,6 +693,118 @@ def _build_param_names(optimizer, named_parameters, noname_prefix):
     return names
 
 
+class _ShardedMixin:
+    """ZeRO-1 for the torch shim (overlaid on ``_DistributedMixin``):
+    gradients hook-allreduce exactly as in the plain wrapper, but each
+    parameter's *optimizer step* runs on a single owning rank and the
+    updated parameter is broadcast from its owner. torch optimizers
+    cannot slice one tensor's step across ranks, so ownership is
+    whole-leaf (``parallel/sharding_policy.assign_owners``: greedy
+    largest-first balance; leaves under the replicate threshold step on
+    every rank with no broadcast). torch materializes per-param state
+    lazily on first step, so each rank only ever allocates state for
+    the params it owns plus the replicated ones — the ~1/N ZeRO-1
+    state footprint, with no state-dict surgery.
+
+    Caveats (docs/sharded_optimizer.md, "torch mode"): ``state_dict()``
+    holds only this rank's shard of optimizer state — gather before
+    checkpointing or save per-rank. After an elastic resize the owner
+    table is rebuilt deterministically from the new world, but state
+    for reassigned params is re-created fresh by torch (momentum for
+    those leaves restarts); the JAX engine re-materializes instead."""
+
+    def _hvd_sharded_setup(self, min_shard_elems):
+        from horovod_tpu.opt.sharded import _resolve_min_shard_elems
+        from horovod_tpu.utils import metrics as _metrics
+
+        self._sharded_min_elems = _resolve_min_shard_elems(min_shard_elems)
+        reg = _metrics.get_registry()
+        wire = "hvd_sharded_update_wire_bytes_total"
+        wire_help = ("sharded-update wire bytes by phase (ring accounting: "
+                     "(N-1)/N of the buffer per RS or AG pass)")
+        self._m_bcast = reg.counter(wire, wire_help, phase="broadcast")
+        self._m_frac = reg.gauge(
+            "hvd_sharded_update_shard_fraction",
+            "fraction of elements on the sharded path (rest replicate)")
+        self._sharded_gen = None
+        self._hvd_build_owners()
+
+    def _hvd_build_owners(self):
+        from horovod_tpu.common import env as env_schema
+        from horovod_tpu.parallel.sharding_policy import assign_owners
+        from horovod_tpu.utils import flightrec
+
+        ps = self._process_set or _core.global_process_set()
+        ws = max(ps.cross_size, 1)
+        rk = ps.cross_rank
+        # param_groups order is the deterministic leaf order — identical
+        # on every rank the same way _build_param_names relies on it
+        params = [p for g in self.param_groups for p in g["params"]]
+        sizes = [p.numel() for p in params]
+        owner_list = assign_owners(sizes, ws,
+                                   min_shard_elems=self._sharded_min_elems)
+        self._sharded_world = ws
+        self._sharded_rank = rk
+        self._owners = dict(zip(params, owner_list))
+        # broadcast root_rank is a chip index: pick each owning
+        # process's first member chip
+        self._owner_chip = {
+            r: next(i for i, d in enumerate(ps.devices)
+                    if d.process_index == ps._proc_indices[r])
+            for r in range(ws)}
+        self._sharded_gen = env_schema.get_int(env_schema.HOROVOD_ELASTIC_GEN,
+                                               0)
+        owned = sum(s for s, o in zip(sizes, owner_list) if o is not None)
+        total = max(sum(sizes), 1)
+        self._m_frac.set(owned / total)
+        flightrec.note("reshard", generation=self._sharded_gen, world=ws,
+                       rank=rk, mode="torch-whole-leaf",
+                       owned_leaves=sum(o is not None for o in owner_list),
+                       replicated_leaves=sum(o is None for o in owner_list))
+
+    def step(self, closure=None):
+        from horovod_tpu.common import env as env_schema
+
+        if self._should_sync:
+            self.synchronize()
+        if self._sharded_gen != env_schema.get_int(
+                env_schema.HOROVOD_ELASTIC_GEN, 0):
+            # elastic resize: every rank recomputes the same owner table
+            # from the new world without communicating
+            self._hvd_build_owners()
+        stashed = []
+        for group in self.param_groups:
+            stashed.append(group["params"])
+            group["params"] = [
+                p for p in group["params"]
+                if self._owners.get(p, None) in (None, self._sharded_rank)]
+        try:
+            loss = self._hvd_base.step(self, closure)
+        finally:
+            for params, group in zip(stashed, self.param_groups):
+                group["params"] = params
+        self._hvd_broadcast_owned()
+        return loss
+
+    def _hvd_broadcast_owned(self):
+        if self._sharded_world <= 1:
+            return
+        handles = []
+        nbytes = 0
+        for p, owner in self._owners.items():
+            if owner is None:
+                continue
+            handles.append(broadcast_async_(
+                p.data, self._owner_chip[owner],
+                f"sharded.{self._names[p]}",
+                process_set=self._process_set))
+            nbytes += p.numel() * p.element_size()
+        for h in handles:
+            synchronize(h)
+        w = self._sharded_world
+        self._m_bcast.inc(int(nbytes * (w - 1) / w))
+
+
 class _AdasumMixin:
     """Delta-Adasum optimizer (reference torch/optimizer.py:329
     _DistributedAdasumOptimizer): each parameter's hook runs the LOCAL
@@ -797,15 +909,25 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          postscale_factor: float = 1.0,
                          gradient_predivide_factor: float = 1.0,
                          sparse_as_dense: bool = False,
-                         process_set=None):
+                         process_set=None,
+                         sharded_update: Optional[bool] = None,
+                         min_shard_elems: Optional[int] = None):
     if hasattr(optimizer, "_hvd_base"):
         # Re-wrapping would make the grafted step() re-enter itself through
         # the newest swapped class (infinite recursion) and register every
         # hook twice.
         raise ValueError(
             "optimizer is already wrapped by DistributedOptimizer")
+    if sharded_update is None:
+        from horovod_tpu.opt.sharded import sharded_update_enabled
+        sharded_update = sharded_update_enabled()
     base = optimizer.__class__
     if op == Adasum and cross_size() > 1:
+        if sharded_update:
+            # Adasum combines *models* (per-param local step + scale-
+            # invariant delta reduction) — there is no shared optimizer
+            # step to shard
+            raise ValueError("sharded_update is not supported with op=Adasum")
         # reference optimizer.py:576: Adasum selects the delta optimizer
         # (size()==1 degenerates to the regular wrapper there and here)
         if (gradient_predivide_factor != 1.0 or prescale_factor != 1.0
@@ -824,13 +946,22 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
         return optimizer
     body = {k: v for k, v in _DistributedMixin.__dict__.items()
             if not k.startswith("__")}
+    cls_prefix = "Distributed"
+    if sharded_update:
+        # overlay: keeps the hook/synchronize machinery, replaces step()
+        # with the owner-restricted step + owner broadcast
+        body.update({k: v for k, v in _ShardedMixin.__dict__.items()
+                     if not k.startswith("__")})
+        cls_prefix = "ShardedDistributed"
     body["_hvd_base"] = base
-    optimizer.__class__ = type("Distributed" + base.__name__, (base,), body)
+    optimizer.__class__ = type(cls_prefix + base.__name__, (base,), body)
     optimizer._hvd_setup(
         list(named_parameters) if named_parameters is not None else None,
         compression, op, backward_passes_per_step,
         prescale_factor, postscale_factor, gradient_predivide_factor,
         sparse_as_dense, process_set)
+    if sharded_update:
+        optimizer._hvd_sharded_setup(min_shard_elems)
     return optimizer
 
 
