@@ -422,6 +422,17 @@ def main():
     extras["sharded_shard_fraction"] = next(
         (round(g["value"], 4) for g in hvd.metrics_snapshot()["gauges"]
          if g["name"] == "hvd_sharded_update_shard_fraction"), None)
+    # Quantized-wire telemetry (docs/performance.md). Same zero-cost
+    # contract: with HOROVOD_COMPRESSION unset these series do not exist,
+    # so absent/zero reads report None — benchmarks/quantized_allreduce.py
+    # is the dedicated wire-format A/B microbench.
+    _q_counters = [c for c in hvd.metrics_snapshot()["counters"]
+                   if c["name"] == "hvd_quant_wire_bytes_total"]
+    _q_wire = sum(c["value"] for c in _q_counters)
+    extras["quant_wire_bytes"] = int(_q_wire) if _q_wire else None
+    _q_fb = sum(c["value"] for c in hvd.metrics_snapshot()["counters"]
+                if c["name"] == "hvd_quant_fallback_total")
+    extras["quant_fallback_tensors"] = int(_q_fb) if _q_fb else None
     # per-span lifecycle summary when HOROVOD_TRACE is on (docs/timeline.md):
     # where did the eager sub-benchmarks' collectives spend their time, and
     # did the coordinator attribute any straggling?

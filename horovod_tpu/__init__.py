@@ -144,15 +144,28 @@ def _default_name(prefix: str, tensor) -> str:
 
 def allreduce_async(tensor, average: Optional[bool] = None, name: Optional[str] = None,
                     *, op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0, process_set: Optional[ProcessSet] = None) -> int:
+                    postscale_factor: float = 1.0, process_set: Optional[ProcessSet] = None,
+                    compression=None) -> int:
     from .ops.collectives import _resolve_op
+    from .ops.compression import NoneCompressor
 
     rt = _runtime()
+    quant = None
+    if compression is not None:
+        quant = getattr(compression, "quant_spec", None)
+        if quant is None and compression is not NoneCompressor \
+                and not isinstance(compression, NoneCompressor):
+            # cast compressors wrap the result synchronously — the async
+            # handle path cannot carry the decompress context; quant
+            # markers are a wire format the runtime owns, so they can
+            raise ValueError(
+                "allreduce_async supports Compression.none/int8/int4; "
+                "use hvd.allreduce(...) for fp16/bf16 cast compression")
     return rt.enqueue(TensorEntry(
         name=name or _default_name("allreduce", tensor), op="allreduce",
         tensor=np.asarray(tensor), reduce_op=_resolve_op(op, average),
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set=process_set))
+        process_set=process_set, quant=quant))
 
 
 def allgather_async(tensor, name: Optional[str] = None,
@@ -209,7 +222,8 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
                             op: Optional[ReduceOp] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
-                            process_set: Optional[ProcessSet] = None) -> list[int]:
+                            process_set: Optional[ProcessSet] = None,
+                            compression=None) -> list[int]:
     """Enqueue a group in one shot; the cycle loop fuses them into a single
     flat collective (reference grouped allreduce + GroupTable)."""
     # unnamed groups get a unique per-call base (reference
@@ -219,7 +233,7 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
     return [allreduce_async(t, average, f"{base}.{i}", op=op,
                             prescale_factor=prescale_factor,
                             postscale_factor=postscale_factor,
-                            process_set=process_set)
+                            process_set=process_set, compression=compression)
             for i, t in enumerate(tensors)]
 
 

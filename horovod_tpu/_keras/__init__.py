@@ -19,11 +19,13 @@ import numpy as np
 import horovod_tpu as _core
 
 
-def _allreduce_np(values, op, prescale, postscale, prefix):
+def _allreduce_np(values, op, prescale, postscale, prefix,
+                  compression=None):
     handles = [
         _core.allreduce_async(np.asarray(v), None, f"{prefix}.{i}", op=op,
                               prescale_factor=prescale,
-                              postscale_factor=postscale)
+                              postscale_factor=postscale,
+                              compression=compression)
         for i, v in enumerate(values)
     ]
     return [np.asarray(_core.synchronize(h)) for h in handles]
@@ -39,6 +41,11 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
     import keras
 
     op = _core.Average if op is None else op
+    # quant markers (Compression.int8/int4) are a runtime wire format —
+    # they ride down to allreduce_async; cast compressors stay a no-op
+    # here as before (the JAX wire already narrows dtypes, common/util)
+    quant_marker = (compression if getattr(
+        compression, "quant_spec", None) is not None else None)
     if gradient_predivide_factor != 1.0:
         if op != _core.Average:
             raise ValueError("gradient_predivide_factor requires op=Average")
@@ -107,7 +114,8 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                 def _reduce(*gs):
                     arrs = [g.numpy() for g in gs]
                     red = _allreduce_np(arrs, wire_op, pre, post,
-                                        "keras.grad")
+                                        "keras.grad",
+                                        compression=quant_marker)
                     return [r.astype(a.dtype) for r, a in zip(red, arrs)]
 
                 reduced = tf.py_function(
@@ -118,7 +126,8 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
                     r.set_shape(g.shape)
                 return list(reduced)
             arrs = [np.asarray(g) for g in grads]
-            reduced = _allreduce_np(arrs, wire_op, pre, post, "keras.grad")
+            reduced = _allreduce_np(arrs, wire_op, pre, post, "keras.grad",
+                                    compression=quant_marker)
             return [keras.ops.convert_to_tensor(r.astype(a.dtype))
                     for r, a in zip(reduced, arrs)]
 

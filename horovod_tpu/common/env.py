@@ -88,6 +88,17 @@ HOROVOD_LOCKCHECK_HOLD_MS = "HOROVOD_LOCKCHECK_HOLD_MS"
 # a leaf stays on the classic allreduce path
 HOROVOD_SHARDED_UPDATE = "HOROVOD_SHARDED_UPDATE"
 HOROVOD_SHARDED_MIN_ELEMS = "HOROVOD_SHARDED_MIN_ELEMS"
+# blockwise quantized wire format (ops/compression.py; docs/performance.md
+# "Quantized allreduce"): none|int8|int4 selects the fused-chunk wire
+# dtype, the per-block element count for absmax scales, the
+# error-feedback master switch, the name-pattern opt-out list, and the
+# small-leaf threshold in elements below which a tensor stays on the
+# uncompressed path. Mutually exclusive with HOROVOD_SHARDED_UPDATE.
+HOROVOD_COMPRESSION = "HOROVOD_COMPRESSION"
+HOROVOD_QUANT_BLOCK = "HOROVOD_QUANT_BLOCK"
+HOROVOD_QUANT_EF = "HOROVOD_QUANT_EF"
+HOROVOD_QUANT_OPTOUT = "HOROVOD_QUANT_OPTOUT"
+HOROVOD_QUANT_MIN_ELEMS = "HOROVOD_QUANT_MIN_ELEMS"
 # native-core sanitizer build: address|thread adds the matching
 # -fsanitize flags to the on-demand g++ build (_native/__init__.py)
 HOROVOD_NATIVE_SANITIZE = "HOROVOD_NATIVE_SANITIZE"
@@ -202,6 +213,13 @@ class RuntimeConfig:
     # the threshold mirrors sharding_policy.DEFAULT_MIN_SHARD_ELEMS
     sharded_update: bool = False
     sharded_min_elems: int = 2 ** 14
+    # blockwise quantized wire (ops/compression.py) — "" keeps the wire
+    # uncompressed (zero-cost contract: no hvd_quant_* series exist)
+    compression: str = ""
+    quant_block: int = 256
+    quant_error_feedback: bool = True
+    quant_optout: str = ""
+    quant_min_elems: int = 4096
     # postmortem layer (utils/flightrec.py, utils/diag.py) — all off by
     # default (flight recorder zero-cost, watchdog thread not created)
     flightrec_enabled: bool = False
@@ -249,6 +267,12 @@ class RuntimeConfig:
         c.sharded_update = get_bool(HOROVOD_SHARDED_UPDATE)
         c.sharded_min_elems = get_int(HOROVOD_SHARDED_MIN_ELEMS,
                                       c.sharded_min_elems)
+        c.compression = get_str(HOROVOD_COMPRESSION).strip().lower()
+        c.quant_block = get_int(HOROVOD_QUANT_BLOCK, c.quant_block)
+        c.quant_error_feedback = get_bool(HOROVOD_QUANT_EF, True)
+        c.quant_optout = get_str(HOROVOD_QUANT_OPTOUT)
+        c.quant_min_elems = get_int(HOROVOD_QUANT_MIN_ELEMS,
+                                    c.quant_min_elems)
         c.flightrec_enabled = get_bool(HOROVOD_FLIGHTREC)
         c.flightrec_buffer = get_int(HOROVOD_FLIGHTREC_BUFFER,
                                      c.flightrec_buffer)

@@ -114,6 +114,80 @@ def _traced_allreduce(x, op, axis_name, prescale_factor, postscale_factor):
     return out
 
 
+def quantized_allreduce(x, axis_name, spec, *, op=ReduceOp.AVERAGE,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        residual=None):
+    """Traced blockwise-quantized allreduce (EQuARX, arXiv:2506.17615).
+
+    Reduce-scatter + allgather with int8/int4 payloads on both wire
+    phases: the local contribution is split into per-peer chunks,
+    quantized (per-block absmax scales), exchanged via ``all_to_all``,
+    dequantized and reduced locally, then the reduced chunk is
+    requantized and ``all_gather``-ed — so every byte crossing the wire
+    is packed payload plus bf16 scale words. The whole chain lives
+    inside the caller's compiled program (arXiv:2209.12769: compression
+    only pays inside the fused program).
+
+    Returns ``(reduced, new_residual)``. ``residual`` is the
+    error-feedback carry in the prescaled domain (same shape as ``x``):
+    it is added before quantization and the fresh quantization error of
+    *this rank's contribution* comes back as ``new_residual`` for the
+    caller to persist (opt.DistributedGradientTransformation keeps it in
+    optimizer state). The second-phase requantization error of the
+    already-reduced chunk is shared by all ranks and is not fed back —
+    matching EQuARX, which feeds back only the contribution error.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized allreduce supports SUM/AVERAGE, got {op!r}")
+    from . import compression as compression_mod
+
+    n = lax.psum(1, axis_name)  # static axis size under shard_map/pmap
+    shape, dtype = x.shape, x.dtype
+    size = int(np.prod(shape)) if shape else 1
+    flat = x.reshape(-1).astype(jnp.float32)
+    if prescale_factor != 1.0:
+        flat = flat * prescale_factor
+    if residual is not None:
+        flat = flat + residual.reshape(-1).astype(jnp.float32)
+    if n == 1 or size == 0:
+        out = flat if postscale_factor == 1.0 else flat * postscale_factor
+        return (out.reshape(shape).astype(dtype),
+                jnp.zeros(shape, jnp.float32))
+    # per-peer chunk size, rounded up to a whole number of absmax blocks
+    csz = -(-size // n)
+    csz = -(-csz // spec.block) * spec.block
+    padded = jnp.pad(flat, (0, csz * n - size))
+    rows = padded.reshape(n, csz)
+    q, s = jax.vmap(lambda r: compression_mod.quantize_blockwise(r, spec))(
+        rows)
+    deq_rows = jax.vmap(
+        lambda qr, sr: compression_mod.dequantize_blockwise(
+            qr, sr, spec, csz))(q, s)
+    err = (rows - deq_rows).reshape(-1)[:size]
+    # reduce-scatter: row j of q/s travels to rank j (quantized wire)
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    contrib = jax.vmap(
+        lambda qr, sr: compression_mod.dequantize_blockwise(
+            qr, sr, spec, csz))(qx, sx)
+    red = (jnp.mean(contrib, axis=0) if op == ReduceOp.AVERAGE
+           else jnp.sum(contrib, axis=0))
+    # allgather: requantize the reduced chunk (quantized wire again)
+    q2, s2 = compression_mod.quantize_blockwise(red, spec)
+    qg = lax.all_gather(q2, axis_name)
+    sg = lax.all_gather(s2, axis_name)
+    full = jax.vmap(
+        lambda qr, sr: compression_mod.dequantize_blockwise(
+            qr, sr, spec, csz))(qg, sg).reshape(-1)[:size]
+    if postscale_factor != 1.0:
+        full = full * postscale_factor
+    return full.reshape(shape).astype(dtype), err.reshape(shape)
+
+
 # ===========================================================================
 # Eager (per-process) path — compiled-program cache
 # ===========================================================================
@@ -540,27 +614,9 @@ def _build_fused_plan(ps, nproc, op, pre, post, sizes, shapes, on_device,
     return FusedChunkPlan(ps, nproc, on_device, pack_j, run_j)
 
 
-def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
-                     names, sizes, shapes, dtype, on_device: bool):
-    """Look up (or compile) the one-dispatch plan for a fused chunk.
-
-    Keyed by the full chunk signature — ordered names, shapes, dtype,
-    reduce op, scale factors, process set, residency, and the current
-    hierarchical verdict (recomputed here so an autotuner flip of the
-    hier flag naturally misses onto a fresh plan rather than replaying a
-    stale topology). Returns ``None`` for chunks no plan covers
-    (zero total elements — those route through the legacy path)."""
-    sizes = tuple(int(s) for s in sizes)
-    if sum(sizes) == 0:
-        return None
-    nproc = ps.cross_size
-    hier = nproc > 1 and _allreduce_hier(op, ps, nproc)
-    # nproc + elastic generation in the signature: an elastic resize can
-    # reuse the set name with a different world size (see _plan_epoch)
-    key = (_PLAN_KEY, "allreduce", ps.name, nproc, _plan_epoch(),
-           tuple(names), tuple(shapes),
-           str(dtype), int(op), float(prescale_factor),
-           float(postscale_factor), bool(on_device), hier)
+def _insert_plan(key, builder):
+    """Shared cache insert for fused-chunk plan flavors: tick hit/miss,
+    LRU-bump, bound by capacity."""
     m = _plan_metrics()
     plan = _EAGER_CACHE.get(key)
     if plan is not None:
@@ -568,15 +624,267 @@ def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
         m[0].inc()
         return plan
     m[1].inc()
-    plan = _build_fused_plan(ps, nproc, op, float(prescale_factor),
-                             float(postscale_factor), sizes, tuple(shapes),
-                             bool(on_device), hier)
+    plan = builder()
     global _plan_count
     _EAGER_CACHE[key] = plan
     _plan_count += 1
     _evict_over_capacity()
     m[4].set(_plan_count)
     return plan
+
+
+def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
+                     names, sizes, shapes, dtype, on_device: bool,
+                     quant=None):
+    """Look up (or compile) the one-dispatch plan for a fused chunk.
+
+    Keyed by the full chunk signature — ordered names, shapes, dtype,
+    reduce op, scale factors, process set, residency, and the current
+    hierarchical verdict (recomputed here so an autotuner flip of the
+    hier flag naturally misses onto a fresh plan rather than replaying a
+    stale topology). Returns ``None`` for chunks no plan covers
+    (zero total elements — those route through the legacy path).
+
+    ``quant`` (a compression.QuantSpec) selects the blockwise-quantized
+    flavor: quantize→stage→dequantize→reduce→unpack as the plan's
+    compiled programs, with the quantization signature APPENDED to the
+    key — when quant is inactive the key is byte-identical to the
+    pre-quantization layout, so existing users' caches survive an
+    upgrade untouched (zero-cost contract). Quantized plans only exist
+    for multi-process SUM/AVERAGE over float chunks; other combinations
+    fall back to the plain plan (the caller counts the fallback)."""
+    sizes = tuple(int(s) for s in sizes)
+    if sum(sizes) == 0:
+        return None
+    nproc = ps.cross_size
+    use_quant = (quant is not None and nproc > 1
+                 and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+                 and np.dtype(str(dtype)).kind == "f")
+    # quantized plans are flat (non-hierarchical): the wire win comes
+    # from the payload width, and the two-level split would requantize
+    # at each level for no extra reduction in cross bytes
+    hier = (not use_quant and nproc > 1
+            and _allreduce_hier(op, ps, nproc))
+    # nproc + elastic generation in the signature: an elastic resize can
+    # reuse the set name with a different world size (see _plan_epoch)
+    key = (_PLAN_KEY, "allreduce", ps.name, nproc, _plan_epoch(),
+           tuple(names), tuple(shapes),
+           str(dtype), int(op), float(prescale_factor),
+           float(postscale_factor), bool(on_device), hier)
+    if use_quant:
+        key = key + (quant.signature(),)
+
+    def build():
+        if use_quant:
+            return _build_quant_fused_plan(
+                ps, nproc, op, float(prescale_factor),
+                float(postscale_factor), sizes, tuple(shapes), dtype,
+                quant)
+        return _build_fused_plan(ps, nproc, op, float(prescale_factor),
+                                 float(postscale_factor), sizes,
+                                 tuple(shapes), bool(on_device), hier)
+
+    return _insert_plan(key, build)
+
+
+# ===========================================================================
+# Quantized fused-chunk plans — the blockwise int8/int4 wire format
+# (EQuARX, arXiv:2506.17615) compiled INTO the chunk programs
+# ===========================================================================
+#
+# Two compiled programs per chunk, same steady-state dispatch count as the
+# plain device plan (pack ≘ quantize, run ≘ dequantize+reduce+unpack):
+#
+# - ``quantize``: ravel+concat the chunk's tensors, prescale, fold in the
+#   error-feedback residual, blockwise-quantize → (packed payload, bf16
+#   scales[, fresh residual]). Runs on this process's contribution only.
+# - ``run``: dequantize every rank's staged payload row, reduce, postscale,
+#   cast back to the chunk dtype, static-slice unpack — one program.
+#
+# Only the packed payload and the scale words are staged across processes
+# (_global_row_array), so wire bytes are payload + scales — the honest
+# number `record_quant_chunk` counts. Keys carry the quantization
+# signature, so flipping HOROVOD_COMPRESSION/HOROVOD_QUANT_BLOCK misses
+# onto fresh programs while steady-state replay stays at zero extra
+# dispatches.
+
+
+class QuantFusedChunkPlan:
+    """Compiled steady-state replay for one quantized fused chunk."""
+
+    __slots__ = ("ps", "nproc", "spec", "flat_size", "padded", "n_blocks",
+                 "wire_bytes", "pre_bytes", "quantize", "run", "_zero_res")
+
+    def __init__(self, ps, nproc, spec, flat_size, padded, n_blocks,
+                 wire_bytes, pre_bytes, quantize, run):
+        self.ps = ps
+        self.nproc = nproc
+        self.spec = spec
+        self.flat_size = flat_size
+        self.padded = padded
+        self.n_blocks = n_blocks
+        self.wire_bytes = wire_bytes
+        self.pre_bytes = pre_bytes
+        self.quantize = quantize
+        self.run = run
+        self._zero_res = None
+
+    def zero_residual(self):
+        """First-step / post-reset error-feedback carry."""
+        if self._zero_res is None:
+            self._zero_res = jnp.zeros((self.flat_size,), jnp.float32)
+        return self._zero_res
+
+    def execute(self, inputs, residual=None):
+        """Dispatch the chunk for this process's ``inputs`` (per-tensor
+        arrays; host tensors are device_put explicitly first, same
+        transfer-guard contract as FusedChunkPlan.execute).
+
+        Returns ``(parts, new_residual)``. The caller owns the residual
+        lifecycle: pass the previous carry in, commit the returned one
+        only after this call succeeded (compression.ResidualStore) —
+        a dispatch that raises must leave the old carry in place."""
+        inputs = [a if isinstance(a, jax.Array) else jax.device_put(a)
+                  for a in inputs]
+        if self.spec.error_feedback:
+            res = residual if residual is not None else self.zero_residual()
+            q, s, new_res = self.quantize(res, *inputs)
+        else:
+            q, s = self.quantize(*inputs)
+            new_res = None
+        gq = _global_row_array(self.ps, q)
+        gs = _global_row_array(self.ps, s)
+        return self.run(gq, gs), new_res
+
+    def execute_simulated(self, rank_inputs, residuals=None):
+        """Single-process lockstep drive of N virtual ranks (tests and
+        benchmarks — the CPU analogue of opt/sharded.py's simulated
+        engines): run ``quantize`` once per virtual rank, stack the
+        payloads in place of the cross-process staging, and replay the
+        same ``run`` program. Returns (parts, new_residuals)."""
+        qs, ss, new_rs = [], [], []
+        for r, arrs in enumerate(rank_inputs):
+            arrs = [a if isinstance(a, jax.Array) else jax.device_put(a)
+                    for a in arrs]
+            if self.spec.error_feedback:
+                res = None if residuals is None else residuals[r]
+                if res is None:
+                    res = self.zero_residual()
+                q, s, nr = self.quantize(res, *arrs)
+                new_rs.append(nr)
+            else:
+                q, s = self.quantize(*arrs)
+                new_rs.append(None)
+            qs.append(q)
+            ss.append(s)
+        parts = self.run(jnp.stack(qs), jnp.stack(ss))
+        return parts, new_rs
+
+
+def _build_quant_fused_plan(ps, nproc, op, pre, post, sizes, shapes, dtype,
+                            spec):
+    from . import compression as compression_mod
+
+    total = sum(sizes)
+    padded, n_blocks, payload_bytes, scale_bytes = \
+        compression_mod.quant_wire_layout(total, spec)
+    wire_bytes = payload_bytes + scale_bytes
+    pre_bytes = total * np.dtype(str(dtype)).itemsize
+
+    def _flatten(arrs):
+        flat = [jnp.ravel(a).astype(jnp.float32) for a in arrs]
+        cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        return cat * pre if pre != 1.0 else cat
+
+    if spec.error_feedback:
+        def quantize(res, *arrs):
+            x = _flatten(arrs) + res
+            q, s = compression_mod.quantize_blockwise(x, spec)
+            deq = compression_mod.dequantize_blockwise(q, s, spec, total)
+            return q, s, x - deq
+    else:
+        def quantize(*arrs):
+            return compression_mod.quantize_blockwise(_flatten(arrs), spec)
+
+    def run(gq, gs):
+        deq = jax.vmap(
+            lambda qr, sr: compression_mod.dequantize_blockwise(
+                qr, sr, spec, padded))(gq, gs)
+        red = (jnp.mean(deq, axis=0) if op == ReduceOp.AVERAGE
+               else jnp.sum(deq, axis=0))
+        if post != 1.0:
+            red = red * post
+        parts = []
+        off = 0
+        for n, shape in zip(sizes, shapes):
+            parts.append(jnp.reshape(
+                lax.slice(red, (off,), (off + n,)), shape).astype(dtype))
+            off += n
+        return parts
+
+    run_j = (jax.jit(run, out_shardings=_replicated(ps)) if ps is not None
+             else jax.jit(run))
+    return QuantFusedChunkPlan(ps, nproc, spec, total, padded, n_blocks,
+                               wire_bytes, pre_bytes, jax.jit(quantize),
+                               run_j)
+
+
+def quant_sim_chunk_plan(world: int, op, prescale_factor, postscale_factor,
+                         names, sizes, shapes, dtype, quant):
+    """Simulated-world flavor of the quantized chunk plan: one process
+    drives ``world`` virtual ranks through the SAME compiled programs
+    (``execute_simulated``), with the same key discipline — the
+    benchmark and the A/B convergence test observe real plan hit/miss
+    behavior on a single-process CPU harness."""
+    sizes = tuple(int(s) for s in sizes)
+    if sum(sizes) == 0:
+        return None
+    key = (_PLAN_KEY, "allreduce", "quant_sim", int(world), _plan_epoch(),
+           tuple(names), tuple(shapes), str(dtype), int(op),
+           float(prescale_factor), float(postscale_factor), True, False,
+           quant.signature())
+
+    def build():
+        return _build_quant_fused_plan(
+            None, int(world), op, float(prescale_factor),
+            float(postscale_factor), sizes, tuple(shapes), dtype, quant)
+
+    return _insert_plan(key, build)
+
+
+def _eager_quantized_allreduce(x, op, ps: ProcessSet, prescale_factor,
+                               postscale_factor, spec, name=None):
+    """Direct-API eager quantized allreduce (``allreduce(...,
+    compression=Compression.int8)``) — one tensor, one quantized chunk
+    plan. Stateless: no error-feedback carry survives between direct
+    calls (a bare tensor has no stable identity to key a residual on);
+    persistent EF lives on the queue runtime and the optimizer wrapper.
+    Falls back to the uncompressed path (counted) when no quantized plan
+    can cover the call."""
+    from . import compression as compression_mod
+
+    xl = _to_local(x)
+    nproc = ps.cross_size
+    reason = None
+    if nproc == 1 or xl.size == 0:
+        reason = "world_size"
+    elif op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        reason = "unsupported_op"
+    elif np.dtype(str(xl.dtype)).kind != "f":
+        reason = "non_float"
+    if reason is not None:
+        compression_mod.quant_fallback_counter(reason).inc()
+        return _eager_allreduce(xl, op, ps, prescale_factor,
+                                postscale_factor)
+    plan = fused_chunk_plan(
+        ps, op, prescale_factor, postscale_factor,
+        (name or "allreduce.anonymous",), (int(xl.size),),
+        (tuple(xl.shape),), str(xl.dtype), isinstance(xl, jax.Array),
+        quant=spec)
+    parts, _ = plan.execute([xl])
+    compression_mod.record_quant_chunk(plan.pre_bytes, plan.wire_bytes,
+                                       spec.bits, plan.n_blocks)
+    return parts[0]
 
 
 # ===========================================================================
@@ -1221,6 +1529,22 @@ def allreduce(
     """
     op = _resolve_op(op, average)
     _check_average_dtype(tensor, op)
+    qspec = (getattr(compression, "quant_spec", None)
+             if compression is not None else None)
+    if qspec is not None:
+        # blockwise-quantized wire: the format lives INSIDE the
+        # collective (compress/decompress on the marker are identity) —
+        # traced calls fuse the EQuARX reduce-scatter/allgather into the
+        # caller's program, eager calls replay a quantized chunk plan
+        if _is_traced(tensor):
+            out, _ = quantized_allreduce(
+                tensor, axis_name, qspec, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return out
+        return _eager_quantized_allreduce(
+            tensor, op, _ps(process_set), prescale_factor,
+            postscale_factor, qspec, name)
     if compression is not None:
         tensor, dectx = compression.compress(tensor)
     if _is_traced(tensor):
@@ -1289,9 +1613,15 @@ def grouped_allreduce(
                  for i in idxs]
         sizes = [f.shape[0] for f in flats]
         fused = (jnp if use_dev else np).concatenate(flats)
+        # quant markers ride DOWN to the fused buffer (compress above was
+        # identity): the whole per-dtype group quantizes as one chunk
+        qmark = (compression if compression is not None
+                 and getattr(compression, "quant_spec", None) is not None
+                 else None)
         red = allreduce(fused, op=op, axis_name=axis_name, process_set=process_set,
                         prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
+                        postscale_factor=postscale_factor,
+                        compression=qmark)
         shapes = tuple(tuple(tensors[i].shape) for i in idxs)
         for i, p in zip(idxs, unpack_flat(red, tuple(sizes), shapes)):
             out[i] = p

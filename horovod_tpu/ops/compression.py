@@ -7,25 +7,48 @@ implementations applied around allreduce.
 On TPU, bfloat16 is the natively supported 16-bit format (the MXU consumes
 bf16 directly), so `Compression.bf16` is the recommended default; `fp16` is
 kept for API parity.
+
+Beyond the cast family, this module owns the blockwise int8/int4
+quantized wire format (EQuARX, arXiv:2506.17615): per-block absmax
+scales (``HOROVOD_QUANT_BLOCK`` elements per block, bf16 scale words on
+the wire), bit-level int4 packing (two values per byte), error-feedback
+residuals that keep the training trajectory on the uncompressed path,
+and the opt-out registry that keeps norms/biases/small leaves off the
+quantized wire. The traceable primitives here are closed over by the
+fused-chunk plans (ops/collectives.py) so quantize→reduce→dequantize
+compiles into the plan programs — compression only pays when it lives
+*inside* the fused program (arXiv:2209.12769), never as extra
+dispatches. Wire accounting is honest: packed payload bytes plus scale
+metadata, not itemsize deltas.
 """
 
 from __future__ import annotations
 
+from typing import NamedTuple, Optional, Tuple
+
 import jax
 import jax.numpy as jnp
 
+from ..common import env as env_schema
 from ..utils import metrics as metrics_mod
 
 _m_pre = None
 _m_post = None
 
 
-def _record_wire_bytes(pre, post):
+def _record_wire_bytes(pre, post, wire_bytes: Optional[int] = None):
     """Pre/post-compression byte counters — concrete (eager) values only.
 
     ``compress`` also runs under jit tracing (opt/_tree_allreduce), where a
     count would fire once per *trace*, not per step; tracers are skipped so
-    the counters stay truthful for the eager wire path they describe."""
+    the counters stay truthful for the eager wire path they describe.
+
+    ``wire_bytes`` overrides the post-side count for wire formats whose
+    footprint ``post.nbytes`` cannot express — bit-packed sub-byte
+    payloads carry two int4 values per byte plus per-block scale words,
+    so the honest number is (packed bytes + scale bytes), not an
+    itemsize delta. ``pre`` may likewise be a plain byte count when the
+    caller already flattened a chunk."""
     if isinstance(pre, jax.core.Tracer) or isinstance(post, jax.core.Tracer):
         return
     global _m_pre, _m_post
@@ -38,8 +61,13 @@ def _record_wire_bytes(pre, post):
                               "payload bytes around compression",
                               stage="post")
     try:
-        _m_pre.inc(int(pre.nbytes))
-        _m_post.inc(int(post.nbytes))
+        pre_b = int(pre.nbytes) if hasattr(pre, "nbytes") else int(pre)
+        if wire_bytes is not None:
+            post_b = int(wire_bytes)
+        else:
+            post_b = int(post.nbytes) if hasattr(post, "nbytes") else int(post)
+        _m_pre.inc(pre_b)
+        _m_post.inc(post_b)
     except (AttributeError, TypeError):
         pass  # duck-typed tensors without nbytes: nothing to count
 
@@ -95,10 +123,351 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+# ===========================================================================
+# Blockwise int8/int4 quantization (EQuARX-style absmax blocks)
+# ===========================================================================
+
+#: Scale words ride the wire in bf16 — TPU-native, 2 bytes per block
+#: (0.78% overhead at the default 256-element block), and the relative
+#: rounding error of a bf16 absmax (<0.4%) is absorbed by error feedback.
+SCALE_DTYPE = jnp.bfloat16
+SCALE_BYTES = 2
+
+#: Small-leaf threshold (elements): below this a tensor stays on the
+#: uncompressed wire — the sharding_policy.DEFAULT_MIN_SHARD_ELEMS idea
+#: at quantization granularity (a handful of 256-element blocks cannot
+#: amortize the quantize/dequantize programs or the scale overhead).
+DEFAULT_QUANT_MIN_ELEMS = 4096
+
+#: Name-pattern opt-outs (case-insensitive substring match): the leaves
+#: whose quantization classically hurts convergence — normalization
+#: scales/offsets and biases. HOROVOD_QUANT_OPTOUT extends this list.
+DEFAULT_OPTOUT_PATTERNS = ("bias", "norm", "bn", "gamma", "beta",
+                           "embedding_scale")
+
+
+class QuantSpec(NamedTuple):
+    """Static quantization signature — folded into fused-plan keys, so a
+    config change misses onto a fresh compiled program."""
+
+    bits: int            # 8 or 4
+    block: int           # elements per absmax block
+    error_feedback: bool
+
+    @property
+    def qmax(self) -> float:
+        return 127.0 if self.bits == 8 else 7.0
+
+    def signature(self) -> tuple:
+        return ("quant", self.bits, self.block, self.error_feedback)
+
+
+def _positive_block(block: int, bits: int) -> int:
+    block = max(int(block), 8)
+    if bits == 4 and block % 2:
+        block += 1  # int4 packs value pairs: blocks must be even
+    return block
+
+
+def make_quant_spec(bits: int, block: Optional[int] = None,
+                    error_feedback: Optional[bool] = None) -> QuantSpec:
+    """Build a spec, filling unset fields from the env knobs."""
+    if bits not in (8, 4):
+        raise ValueError(f"quantized wire supports 8 or 4 bits, got {bits}")
+    if block is None:
+        block = env_schema.get_int(env_schema.HOROVOD_QUANT_BLOCK, 256)
+    if error_feedback is None:
+        error_feedback = env_schema.get_bool(env_schema.HOROVOD_QUANT_EF,
+                                             True)
+    return QuantSpec(int(bits), _positive_block(block, bits),
+                     bool(error_feedback))
+
+
+def resolve_quant_spec(config=None) -> Optional[QuantSpec]:
+    """The runtime wire spec from ``HOROVOD_COMPRESSION`` (or an already
+    parsed RuntimeConfig) — None when the wire stays uncompressed.
+
+    Cast compression (fp16/bf16) remains a caller-side choice
+    (``Compression.bf16`` on the API); the env knob governs only the
+    runtime's fused-chunk wire, so unknown values fail loudly instead of
+    silently shipping uncompressed bytes."""
+    block = ef = None
+    if config is not None:
+        mode = (getattr(config, "compression", "") or "").strip().lower()
+        block = getattr(config, "quant_block", None)
+        ef = getattr(config, "quant_error_feedback", None)
+    else:
+        mode = env_schema.get_str(env_schema.HOROVOD_COMPRESSION) \
+            .strip().lower()
+    if mode in ("", "none", "0", "off"):
+        return None
+    if mode == "int8":
+        return make_quant_spec(8, block, ef)
+    if mode == "int4":
+        return make_quant_spec(4, block, ef)
+    raise ValueError(
+        f"{env_schema.HOROVOD_COMPRESSION}={mode!r}: supported values are "
+        "none|int8|int4 (fp16/bf16 cast compression is selected per call "
+        "via Compression.fp16/Compression.bf16, not the env knob)")
+
+
+def quant_optout_patterns() -> Tuple[str, ...]:
+    """Default + user opt-out substrings, lowercased."""
+    extra = env_schema.get_str(env_schema.HOROVOD_QUANT_OPTOUT)
+    pats = list(DEFAULT_OPTOUT_PATTERNS)
+    for p in extra.split(","):
+        p = p.strip().lower()
+        if p and p not in pats:
+            pats.append(p)
+    return tuple(pats)
+
+
+def quant_min_elems() -> int:
+    return env_schema.get_int(env_schema.HOROVOD_QUANT_MIN_ELEMS,
+                              DEFAULT_QUANT_MIN_ELEMS)
+
+
+def quant_fallback_reason(name: str, size: int, dtype,
+                          patterns: Tuple[str, ...],
+                          min_elems: int) -> Optional[str]:
+    """Why this tensor must stay off the quantized wire, or None when it
+    is eligible. Reasons are the closed label set of
+    ``hvd_quant_fallback_total{reason=...}``."""
+    import numpy as np
+
+    kind = np.dtype(str(dtype)).kind
+    if kind != "f":
+        return "non_float"
+    if int(size) < int(min_elems):
+        return "small_leaf"
+    low = (name or "").lower()
+    for p in patterns:
+        if p in low:
+            return "optout_match"
+    return None
+
+
+def quant_wire_layout(n_elems: int, spec: QuantSpec) -> Tuple[int, int, int, int]:
+    """(padded_elems, n_blocks, payload_bytes, scale_bytes) for a flat
+    buffer of ``n_elems``. Payload is bit-level honest: int4 packs two
+    values per byte; scales add SCALE_BYTES per block."""
+    n = int(n_elems)
+    block = spec.block
+    padded = -(-n // block) * block
+    nblocks = padded // block
+    payload = padded if spec.bits == 8 else padded // 2
+    return padded, nblocks, payload, nblocks * SCALE_BYTES
+
+
+def quantize_blockwise(flat, spec: QuantSpec):
+    """Traceable ``flat[n] float -> (packed, scales)``.
+
+    Per-block symmetric absmax: scale = max|x| / qmax, q = round(x/scale)
+    clipped to ±qmax. int8 payload keeps one int8 per element; int4 packs
+    consecutive value pairs into one uint8 (low nibble first), both in
+    two's complement. All-zero blocks quantize with scale 1 so the
+    dequantized result is exactly zero."""
+    block, qmax = spec.block, spec.qmax
+    n = flat.shape[0]
+    pad = (-n) % block
+    x = flat.astype(jnp.float32)
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    xb = x.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(xb), axis=1)
+    scales = jnp.where(absmax > 0.0, absmax / qmax, 1.0)
+    # quantize against the bf16-rounded scale the wire actually carries,
+    # so dequantization on the far side is bit-exact with the local
+    # error-feedback computation
+    wire_scales = scales.astype(SCALE_DTYPE)
+    eff = wire_scales.astype(jnp.float32)
+    q = jnp.clip(jnp.round(xb / eff[:, None]), -qmax, qmax) \
+        .astype(jnp.int8).reshape(-1)
+    if spec.bits == 8:
+        return q, wire_scales
+    u = q.astype(jnp.uint8) & jnp.uint8(0xF)  # two's-complement nibbles
+    packed = u[0::2] | (u[1::2] << 4)
+    return packed, wire_scales
+
+
+def dequantize_blockwise(packed, scales, spec: QuantSpec, n_elems: int):
+    """Traceable inverse of :func:`quantize_blockwise` → ``float32[n]``."""
+    if spec.bits == 8:
+        q = packed.astype(jnp.int8)
+    else:
+        lo = (packed & jnp.uint8(0xF)).astype(jnp.int8)
+        hi = (packed >> 4).astype(jnp.int8)
+        # sign-extend the 4-bit two's complement nibble
+        lo = ((lo ^ 8) - 8).astype(jnp.int8)
+        hi = ((hi ^ 8) - 8).astype(jnp.int8)
+        q = jnp.stack([lo, hi], axis=-1).reshape(-1)
+    xb = q.reshape(-1, spec.block).astype(jnp.float32)
+    out = (xb * scales.astype(jnp.float32)[:, None]).reshape(-1)
+    return out[:n_elems]
+
+
+# --- quantization metrics (registered lazily: the zero-cost contract
+# says no hvd_quant_* series exists until the quantized wire is used) ---
+
+_quant_handles = None
+_fallback_handles: dict = {}
+
+
+def quant_metric_handles():
+    """(wire_bytes{bits=8}, wire_bytes{bits=4}, blocks_total) — resolved
+    once, on first quantized dispatch."""
+    global _quant_handles
+    if _quant_handles is None:
+        reg = metrics_mod.get_registry()
+        _quant_handles = (
+            reg.counter("hvd_quant_wire_bytes_total",
+                        "quantized wire bytes (packed payload + scales)",
+                        bits="8"),
+            reg.counter("hvd_quant_wire_bytes_total",
+                        "quantized wire bytes (packed payload + scales)",
+                        bits="4"),
+            reg.counter("hvd_quant_blocks_total",
+                        "absmax blocks quantized"),
+        )
+    return _quant_handles
+
+
+def quant_fallback_counter(reason: str):
+    h = _fallback_handles.get(reason)
+    if h is None:
+        reg = metrics_mod.get_registry()
+        h = reg.counter("hvd_quant_fallback_total",
+                        "tensors kept off the quantized wire",
+                        reason=reason)
+        _fallback_handles[reason] = h
+    return h
+
+
+def record_quant_chunk(pre_bytes: int, wire_bytes: int, bits: int,
+                       n_blocks: int) -> None:
+    """Honest per-dispatch accounting for one quantized chunk: the
+    compression pre/post counters (so existing dashboards keep working)
+    plus the quant-specific series."""
+    _record_wire_bytes(int(pre_bytes), None, wire_bytes=int(wire_bytes))
+    w8, w4, blocks = quant_metric_handles()
+    (w8 if bits == 8 else w4).inc(int(wire_bytes))
+    blocks.inc(int(n_blocks))
+
+
+# --- error-feedback residual store (eager/queue path) ----------------------
+
+
+class ResidualStore:
+    """Per-chunk error-feedback residuals for the background cycle loop.
+
+    Keyed by the chunk's ordered tensor-name tuple — the flat residual IS
+    the concatenation of the per-tensor residuals in pack order, so the
+    semantics are per-tensor while the storage matches the compiled
+    plan's flat layout. Only the cycle thread touches the store (the
+    queue runtime owns it), so no lock is needed.
+
+    Commit protocol: a residual is read before dispatch and committed
+    only after the compiled program ran — a negotiation retry or a failed
+    dispatch leaves the previous residual in place, so the error is never
+    double-applied and never lost.
+
+    Elastic hygiene: the store remembers the elastic generation it was
+    filled under; a generation change (2→3 resize) resets every residual
+    (peers changed — stale errors describe a dead topology), and a
+    shape mismatch (chunk boundaries moved) drops just that entry instead
+    of crashing the cycle loop.
+    """
+
+    def __init__(self):
+        self._res: dict = {}
+        self._epoch = self._gen()
+
+    @staticmethod
+    def _gen() -> int:
+        return env_schema.get_int(env_schema.HOROVOD_ELASTIC_GEN, 0)
+
+    def _check_epoch(self) -> None:
+        gen = self._gen()
+        if gen != self._epoch:
+            self._res.clear()
+            self._epoch = gen
+
+    def get(self, key: tuple, flat_size: int):
+        """The residual to fold into this dispatch, or None (first step,
+        post-resize reset, or a stale shape)."""
+        self._check_epoch()
+        r = self._res.get(key)
+        if r is not None and int(r.shape[0]) != int(flat_size):
+            self._res.pop(key, None)  # chunk layout moved: reset cleanly
+            return None
+        return r
+
+    def commit(self, key: tuple, residual) -> None:
+        self._check_epoch()
+        self._res[key] = residual
+
+    def reset(self) -> None:
+        self._res.clear()
+        self._epoch = self._gen()
+
+    def __len__(self) -> int:
+        return len(self._res)
+
+
+# --- API-surface quantized compressor markers ------------------------------
+
+
+class QuantCompressor(Compressor):
+    """`Compression.int8` / `Compression.int4` — a *marker* compressor.
+
+    Blockwise quantization cannot ride the cast-compressor contract
+    (summing packed int payloads is not the sum of the values), so the
+    collective paths detect ``quant_spec`` on the compression argument
+    and compile the quantize→reduce→dequantize chain into the collective
+    program itself (`ops/collectives.quantized_allreduce` on the traced
+    path, the quant fused-chunk plans on the eager/queue path).
+    ``compress``/``decompress`` are therefore identity — the wire format
+    lives inside the collective, not around it."""
+
+    def __init__(self, bits: int, block: Optional[int] = None,
+                 error_feedback: Optional[bool] = None):
+        self._bits = bits
+        self._block = block
+        self._error_feedback = error_feedback
+
+    @property
+    def quant_spec(self) -> QuantSpec:
+        """Resolved lazily so env defaults (block size, error feedback)
+        are read at use time, not import time."""
+        return make_quant_spec(self._bits, self._block,
+                               self._error_feedback)
+
+    def with_options(self, block: Optional[int] = None,
+                     error_feedback: Optional[bool] = None
+                     ) -> "QuantCompressor":
+        """A customized copy (e.g. ``Compression.int4.with_options(
+        error_feedback=False)`` for ablations)."""
+        return QuantCompressor(
+            self._bits,
+            self._block if block is None else block,
+            self._error_feedback if error_feedback is None
+            else error_feedback)
+
+    def compress(self, tensor):
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor
+
+
 class Compression:
     """Optional gradient compression algorithm used during allreduce
-    (reference compression.py:66-75)."""
+    (reference compression.py:66-75). ``int8``/``int4`` select the
+    blockwise quantized wire (docs/performance.md, "Quantized
+    allreduce")."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = QuantCompressor(8)
+    int4 = QuantCompressor(4)

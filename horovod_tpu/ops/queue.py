@@ -50,6 +50,7 @@ from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
 from . import collectives as C
+from . import compression as compression_mod
 
 LOG = logging.getLogger("horovod_tpu")
 
@@ -67,6 +68,9 @@ class TensorEntry:
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
     process_set: Any = None
+    # per-call quantized-wire override (compression.QuantSpec) from a
+    # Compression.int8/int4 marker; None defers to HOROVOD_COMPRESSION
+    quant: Any = None
     handle: int = -1
     enqueue_time: float = field(default_factory=time.monotonic)
     # lifecycle trace span (utils/tracing.py); None unless HOROVOD_TRACE
@@ -243,6 +247,18 @@ class BackgroundRuntime:
         # loop and negotiation bracket at one is-None check each
         self.recorder = flightrec_mod.get_recorder()
         self.watchdog = diag_mod.get_watchdog()
+        # blockwise quantized wire (ops/compression.py): resolved ONCE.
+        # None keeps every quant hook below at a single is-None/or check —
+        # the zero-cost contract (tests/test_quantized.py asserts no
+        # hvd_quant_* series exist when HOROVOD_COMPRESSION is unset).
+        self._quant = compression_mod.resolve_quant_spec(config)
+        # residual store / opt-out registry materialize lazily on the
+        # first quantized group (a per-call Compression.int8 marker can
+        # arrive with the env knob unset)
+        self._quant_residuals = None
+        self._quant_optout = None
+        self._quant_min_elems = 0
+        self._quant_noted: set = set()
         self.controller = self._maybe_controller()
         if self.controller is not None:
             self.controller.on_params = self._apply_tuned_params
@@ -504,7 +520,11 @@ class BackgroundRuntime:
                 # the runtime set it resolves to at dispatch.
                 ps = e.process_set or self.process_set
                 key = (dtype, int(e.reduce_op), e.prescale_factor,
-                       e.postscale_factor, getattr(ps, "name", "global"))
+                       e.postscale_factor, getattr(ps, "name", "global"),
+                       # per-call quant markers must not fuse with
+                       # differently-quantized (or unquantized) entries —
+                       # the chunk shares one wire format
+                       None if e.quant is None else e.quant.signature())
                 fusable.setdefault(key, []).append(e)
             else:
                 singles.append(e)
@@ -680,10 +700,68 @@ class BackgroundRuntime:
             entry.span = None
         self.handles.mark_done(entry.handle, result, exc)
 
+    def _quant_spec_for(self, group: list[TensorEntry]):
+        """Effective quantization spec for a fused group: a per-call
+        marker wins (the group key guarantees it is uniform), else the
+        HOROVOD_COMPRESSION runtime default. One or-check when both are
+        None — the zero-cost contract."""
+        return group[0].quant or self._quant
+
+    def _quant_split(self, group: list[TensorEntry], spec):
+        """Partition a fused group into (quantized, uncompressed) per the
+        convergence guardrails: name-pattern opt-outs, the small-leaf
+        threshold, non-float dtypes — and worlds with no wire to
+        compress. Every fallback decision is counted
+        (hvd_quant_fallback_total{reason}) and noted once per tensor
+        name in the flight recorder, so a postmortem bundle explains
+        surprising wire bytes."""
+        if self._quant_optout is None:  # lazy: first quantized group
+            self._quant_optout = compression_mod.quant_optout_patterns()
+            self._quant_min_elems = compression_mod.quant_min_elems()
+            self._quant_residuals = compression_mod.ResidualStore()
+
+        def _fallback(e, reason):
+            mark = (e.name, reason)
+            if mark not in self._quant_noted:
+                self._quant_noted.add(mark)
+                compression_mod.quant_fallback_counter(reason).inc()
+                flightrec_mod.note("quant_fallback", name=e.name,
+                                   reason=reason)
+
+        ps = group[0].process_set or self.process_set
+        if ps.cross_size <= 1 or not self._plans_enabled:
+            # no wire to compress (or plans off): the whole group stays
+            # uncompressed; a single-process run is how the zero-cost
+            # tests drive the runtime, so note it like any other fallback
+            for e in group:
+                _fallback(e, "world_size" if ps.cross_size <= 1
+                          else "plans_disabled")
+            return [], group
+        quant, plain = [], []
+        for e in group:
+            t = e.tensor
+            size = int(getattr(t, "size", None) or np.asarray(t).size)
+            reason = compression_mod.quant_fallback_reason(
+                e.name, size, getattr(t, "dtype", "float32"),
+                self._quant_optout, self._quant_min_elems)
+            if reason is None:
+                quant.append(e)
+            else:
+                _fallback(e, reason)
+                plain.append(e)
+        return quant, plain
+
     def _run_fused_allreduce(self, group: list[TensorEntry]):
         """Fuse up to fusion_threshold bytes into one flat compiled psum
         (the MEMCPY_IN_FUSION_BUFFER → op → MEMCPY_OUT of
         collective_operations.h:65-88, done by XLA as concat/slice fusion)."""
+        spec = self._quant_spec_for(group)
+        if spec is not None:
+            qgroup, group = self._quant_split(group, spec)
+            if qgroup:
+                self._run_quant_allreduce(qgroup, spec)
+            if not group:
+                return
         # chunk the group by threshold
         chunk: list[TensorEntry] = []
         nbytes = 0
@@ -790,6 +868,106 @@ class BackgroundRuntime:
         if lease is not None:
             lease.retire(parts[0])
         return parts
+
+    def _run_quant_allreduce(self, group: list[TensorEntry], spec):
+        """Quantized flavor of ``_run_fused_allreduce``: same chunking,
+        same one-program steady state, but the chunk replays a
+        QuantFusedChunkPlan — quantize→stage→dequantize→reduce→unpack
+        with only packed payload + scale words on the wire.
+
+        Error-feedback lifecycle: the residual for a chunk (keyed by its
+        ordered tensor names + quant signature) is read before dispatch
+        and committed only AFTER the compiled program ran — a failed or
+        retried dispatch leaves the previous carry in place, so the
+        error is never double-applied (tests/test_quantized.py chaos
+        coverage). The store itself resets on elastic-generation change
+        (compression.ResidualStore)."""
+        chunk: list[TensorEntry] = []
+        nbytes = 0
+        chunks = []
+        for e in group:
+            sz = getattr(e.tensor, "nbytes", None)
+            if sz is None:
+                sz = np.asarray(e.tensor).nbytes
+            if chunk and nbytes + sz > self.fusion_threshold:
+                chunks.append(chunk)
+                chunk, nbytes = [], 0
+            chunk.append(e)
+            nbytes += sz
+        if chunk:
+            chunks.append(chunk)
+        store = self._quant_residuals
+        for chunk in chunks:
+            names = [e.name for e in chunk]
+            t0 = time.perf_counter()
+            if self.timeline:
+                for n in names:
+                    self.timeline.start_activity(n, "QUANT_FUSED_ALLREDUCE")
+            try:
+                on_dev = all(C.is_device_resident(e.tensor) for e in chunk)
+                if on_dev:
+                    arrs = [e.tensor for e in chunk]
+                else:
+                    arrs = [np.asarray(e.tensor) for e in chunk]
+                e0 = chunk[0]
+                ps = e0.process_set or self.process_set
+                sizes = tuple(int(a.size) for a in arrs)
+                shapes = tuple(tuple(a.shape) for a in arrs)
+                dtype = str(arrs[0].dtype)
+                total_bytes = sum(int(a.nbytes) for a in arrs)
+                plan = C.fused_chunk_plan(
+                    ps, e0.reduce_op, e0.prescale_factor,
+                    e0.postscale_factor, tuple(names), sizes, shapes,
+                    dtype, on_dev, quant=spec)
+                if self.tracer is not None:
+                    disp0 = time.time()
+                    for e in chunk:
+                        if e.span is not None:
+                            e.span.t[tracing_mod.T_DISPATCH_START] = disp0
+                            e.span.chunk_bytes = total_bytes
+                            e.span.chunk_tensors = len(chunk)
+                if isinstance(plan, C.QuantFusedChunkPlan):
+                    rkey = (tuple(names), spec.signature())
+                    residual = (store.get(rkey, plan.flat_size)
+                                if spec.error_feedback else None)
+                    parts, new_res = plan.execute(arrs, residual)
+                    if new_res is not None:
+                        # commit AFTER the dispatch succeeded — see
+                        # docstring
+                        store.commit(rkey, new_res)
+                    compression_mod.record_quant_chunk(
+                        plan.pre_bytes, plan.wire_bytes, spec.bits,
+                        plan.n_blocks)
+                elif plan is not None:
+                    # fused_chunk_plan declined the quant flavor (e.g. an
+                    # unsupported op slipped through): plain plan dispatch
+                    parts = self._dispatch_plan(plan, arrs, on_dev)
+                else:
+                    parts = self._dispatch_legacy(arrs, on_dev, e0, ps,
+                                                  sizes, shapes)
+                if self.tracer is not None:
+                    disp1 = time.time()
+                    for e in chunk:
+                        if e.span is not None:
+                            e.span.t[tracing_mod.T_DISPATCH_END] = disp1
+                self.bytes_processed += total_bytes
+                m_bytes, m_lat, m_ops = self._op_metrics("allreduce", dtype)
+                m_bytes.inc(total_bytes)
+                m_ops.inc()
+                m_lat.observe(time.perf_counter() - t0)
+                self._m_fusion_batch.observe(len(chunk))
+                self._m_fused_bytes.observe(total_bytes)
+                for e, p in zip(chunk, parts):
+                    self._finish(e, p)
+            except Exception as exc:
+                self._m_op_errors.inc(len(chunk))
+                for e in chunk:
+                    self._finish(e, None, HorovodInternalError(
+                        f"quantized fused allreduce failed: {exc}"))
+            finally:
+                if self.timeline:
+                    for n in names:
+                        self.timeline.end_activity(n)
 
     def _dispatch_legacy(self, arrs, on_dev, e0, ps, sizes, shapes):
         """Pre-plan eager chain (kept as the HOROVOD_FUSED_PLAN_DISABLE

@@ -49,6 +49,15 @@ from ..ops.collectives import ReduceOp
 
 def _tree_allreduce(grads, op, axis_name, compression, prescale, postscale,
                     fuse_buckets: bool):
+    qspec = (getattr(compression, "quant_spec", None)
+             if compression is not None else None)
+    if qspec is not None:
+        # stateless quantized reduce (no error-feedback carry across
+        # calls — persistent EF lives in the optimizer wrapper's state)
+        red, _ = quantized_tree_allreduce(
+            grads, qspec, op=op, axis_name=axis_name,
+            prescale_factor=prescale, postscale_factor=postscale)
+        return red
     if fuse_buckets:
         return fused_tree_allreduce(grads, op=op, axis_name=axis_name,
                                     compression=compression,
@@ -60,6 +69,114 @@ def _tree_allreduce(grads, op, axis_name, compression, prescale, postscale,
                               prescale_factor=prescale,
                               postscale_factor=postscale),
         grads)
+
+
+def _quant_partition(tree):
+    """Split a gradient pytree into quantization-eligible and fallback
+    leaf indices per the convergence guardrails (ops/compression.py):
+    name-pattern opt-outs (the tree path is the name), the small-leaf
+    threshold, non-float dtypes. Pure Python over static metadata — runs
+    at trace time, and the fallback counters tick once per (re)trace,
+    matching their once-per-tensor semantics."""
+    from ..ops import compression as compression_mod
+
+    lwp, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    pats = compression_mod.quant_optout_patterns()
+    mn = compression_mod.quant_min_elems()
+    elig, plain = [], []
+    for i, (path, leaf) in enumerate(lwp):
+        name = jax.tree_util.keystr(path)
+        reason = compression_mod.quant_fallback_reason(
+            name, jnp.asarray(leaf).size, jnp.asarray(leaf).dtype,
+            pats, mn)
+        if reason is None:
+            elig.append(i)
+        else:
+            compression_mod.quant_fallback_counter(reason).inc()
+            plain.append(i)
+    return [leaf for _, leaf in lwp], treedef, elig, plain
+
+
+def quantized_tree_allreduce(tree, spec, *, op=ReduceOp.AVERAGE,
+                             axis_name=DEFAULT_AXIS, prescale_factor=1.0,
+                             postscale_factor=1.0, residuals=None):
+    """Tensor-fused blockwise-quantized tree allreduce (traced path).
+
+    Eligible leaves fuse into one flat buffer per dtype and go through
+    ``collectives.quantized_allreduce`` — the EQuARX reduce-scatter/
+    allgather with int8/int4 payloads compiled into the caller's
+    program. Guardrail leaves (opt-outs, small leaves, non-floats) ride
+    the plain fused psum. Returns ``(reduced_tree, new_residuals)``
+    where ``new_residuals`` maps the per-dtype fused-buffer key to this
+    rank's fresh quantization error; pass it back as ``residuals`` next
+    step for error feedback (DistributedGradientTransformation stores it
+    in optimizer state and does exactly that)."""
+    from ..ops import compression as compression_mod
+
+    leaves, treedef, elig, plain = _quant_partition(tree)
+    if not leaves:
+        return tree, {}
+    out = [None] * len(leaves)
+    new_res: dict = {}
+    traced = any(C._is_traced(l) for l in leaves)
+
+    def _by_dtype(idxs):
+        groups: dict = {}
+        for i in idxs:
+            groups.setdefault(str(jnp.asarray(leaves[i]).dtype), []).append(i)
+        return dict(sorted(groups.items()))
+
+    for dt, idxs in _by_dtype(plain).items():
+        flats = [jnp.ravel(leaves[i]) for i in idxs]
+        fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        red = C.allreduce(fused, op=op, axis_name=axis_name,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = jnp.reshape(red[off:off + n], jnp.shape(leaves[i]))
+            off += n
+    for dt, idxs in _by_dtype(elig).items():
+        flats = [jnp.ravel(leaves[i]) for i in idxs]
+        fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        if traced:
+            res = residuals.get(dt) if residuals else None
+            if res is not None and res.shape != fused.shape:
+                res = None  # layout moved (resize/re-trace): clean reset
+            red, err = C.quantized_allreduce(
+                fused, axis_name, spec, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, residual=res)
+            new_res[dt] = err
+        else:
+            # eager call (no axis in scope): the quant marker routes the
+            # fused buffer through the eager quantized chunk plan;
+            # stateless — the queue runtime owns eager error feedback
+            marker = compression_mod.QuantCompressor(
+                spec.bits, spec.block, spec.error_feedback)
+            red = C.allreduce(fused, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              compression=marker)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = jnp.reshape(red[off:off + n], jnp.shape(leaves[i]))
+            off += n
+    return jax.tree.unflatten(treedef, out), new_res
+
+
+def quant_residual_init(params, spec):
+    """Zero error-feedback carries matching the fused-buffer layout
+    ``quantized_tree_allreduce`` will use for this parameter tree — the
+    init half of the optimizer-state EF contract."""
+    leaves, _, elig, _ = _quant_partition(params)
+    res: dict = {}
+    for i in elig:
+        dt = str(jnp.asarray(leaves[i]).dtype)
+        res[dt] = res.get(dt, 0) + int(jnp.asarray(leaves[i]).size)
+    return {dt: jnp.zeros((n,), jnp.float32) for dt, n in res.items()}
 
 
 def fused_tree_allreduce(tree, *, op=ReduceOp.AVERAGE, axis_name=DEFAULT_AXIS,
@@ -99,6 +216,14 @@ class _AggState(NamedTuple):
     inner: optax.OptState
     acc: optax.Updates
     counter: jnp.ndarray
+
+
+class _QuantEFState(NamedTuple):
+    """Optimizer state wrapper carrying the error-feedback residuals for
+    the quantized wire (per-dtype fused-buffer flat float32 arrays)."""
+
+    inner: optax.OptState
+    residuals: dict
 
 
 def DistributedGradientTransformation(
@@ -151,6 +276,35 @@ def DistributedGradientTransformation(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor)
     n = backward_passes_per_step
+    qspec = (getattr(compression, "quant_spec", None)
+             if compression is not None else None)
+    if qspec is not None and qspec.error_feedback:
+        # persistent error feedback: the residual carry lives in the
+        # optimizer state so it survives across steps and checkpoints —
+        # and resets naturally with a fresh init after an elastic resize
+        if n > 1:
+            raise ValueError(
+                "quantized compression with error feedback does not "
+                "compose with backward_passes_per_step > 1 — accumulate "
+                "outside the optimizer, or disable error feedback "
+                "(Compression.int8.with_options(error_feedback=False))")
+
+        def q_init_fn(params):
+            return _QuantEFState(optimizer.init(params),
+                                 quant_residual_init(params, qspec))
+
+        def q_update_fn(grads, state, params=None):
+            reduced, new_res = quantized_tree_allreduce(
+                grads, qspec, op=op, axis_name=axis_name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                residuals=state.residuals)
+            updates, inner = optimizer.update(reduced, state.inner, params)
+            if not new_res:
+                new_res = state.residuals  # eager call: carry unchanged
+            return updates, _QuantEFState(inner, new_res)
+
+        return optax.GradientTransformation(q_init_fn, q_update_fn)
 
     def init_fn(params):
         inner = optimizer.init(params)

@@ -80,7 +80,23 @@ def _resolve_min_shard_elems(min_shard_elems: Optional[int]) -> int:
 def sharded_update_enabled() -> bool:
     """The ``HOROVOD_SHARDED_UPDATE`` knob (shims consult this when the
     caller passes ``sharded_update=None``)."""
-    return env_schema.get_bool(env_schema.HOROVOD_SHARDED_UPDATE)
+    enabled = env_schema.get_bool(env_schema.HOROVOD_SHARDED_UPDATE)
+    if enabled:
+        # mutual exclusion with the quantized wire (docs/
+        # sharded_optimizer.md): the reduce-scatter shard is never
+        # materialized as a full tensor to compress, and quantizing the
+        # shard would desynchronize the replicated allgather result.
+        # Composing the two (quantized reduce-scatter à la ZeRO++) is
+        # future work — fail loudly instead of silently ignoring a knob.
+        mode = env_schema.get_str(env_schema.HOROVOD_COMPRESSION) \
+            .strip().lower()
+        if mode not in ("", "none", "0", "off"):
+            raise ValueError(
+                f"{env_schema.HOROVOD_SHARDED_UPDATE} and "
+                f"{env_schema.HOROVOD_COMPRESSION}={mode!r} are mutually "
+                "exclusive: the sharded update path cannot run the "
+                "quantized wire (see docs/sharded_optimizer.md)")
+    return enabled
 
 
 # ===========================================================================

@@ -140,6 +140,11 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
             values = values / tf.cast(n, values.dtype)
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
+    # quant markers select the runtime's blockwise-quantized wire;
+    # compress() below is identity for them (ops/compression.py)
+    _qm = (compression if getattr(compression, "quant_spec", None)
+           is not None else None)
+
     @tf.custom_gradient
     def _op(t_in):
         t, ctx = compression.compress(t_in)
@@ -148,7 +153,8 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
             h = _core.allreduce_async(_to_np(x), average, name, op=op,
                                       prescale_factor=prescale_factor,
                                       postscale_factor=postscale_factor,
-                                      process_set=process_set)
+                                      process_set=process_set,
+                                      compression=_qm)
             return _from_np(_core.synchronize(h), t.dtype)
 
         # Under tf.function the tensors are symbolic; the numpy bridge
@@ -195,6 +201,8 @@ def grouped_allreduce(tensors, average=None, device_dense="",
     # path hot; unnamed calls get a unique base so concurrent groups can't
     # collide on the in-flight name guard
     base = name or f"grouped.tf.noname.{next(_group_counter)}"
+    _qm = (compression if getattr(compression, "quant_spec", None)
+           is not None else None)
 
     @tf.custom_gradient
     def _op(*ts):
@@ -206,7 +214,8 @@ def grouped_allreduce(tensors, average=None, device_dense="",
                                         op=op,
                                         prescale_factor=prescale_factor,
                                         postscale_factor=postscale_factor,
-                                        process_set=process_set)
+                                        process_set=process_set,
+                                        compression=_qm)
                   for i, x in enumerate(xs)]
             return [_from_np(_core.synchronize(h), d)
                     for h, d in zip(hs, dtypes)]

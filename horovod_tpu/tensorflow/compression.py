@@ -52,7 +52,19 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = tf.bfloat16
 
 
+def _quant_marker(bits: int):
+    """The shared blockwise-quantized wire markers (ops/compression.py):
+    compress/decompress are identity on the TF side — the runtime
+    compiles the quantization into the fused chunk programs and the
+    marker's ``quant_spec`` is what the collective paths read."""
+    from ..ops.compression import Compression as _CoreCompression
+
+    return _CoreCompression.int8 if bits == 8 else _CoreCompression.int4
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = _quant_marker(8)
+    int4 = _quant_marker(4)

@@ -73,7 +73,11 @@ from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
 
 
 class Compression:
-    """fp16-on-the-wire compression (reference torch/compression.py)."""
+    """fp16-on-the-wire compression (reference torch/compression.py),
+    plus the blockwise-quantized wire markers (``int8``/``int4``): their
+    torch-side compress/decompress is identity — the runtime compiles
+    the quantization into the fused chunk programs and applies error
+    feedback there (docs/performance.md, "Quantized allreduce")."""
 
     class none:
         @staticmethod
@@ -94,6 +98,18 @@ class Compression:
         @staticmethod
         def decompress(t, ctx):
             return t.to(ctx) if ctx is not None else t
+
+
+def _quant_markers():
+    # resolved from the core module so the torch surface and the JAX
+    # surface share one spec type (ops/compression.py)
+    from horovod_tpu.ops.compression import Compression as _CoreCompression
+
+    Compression.int8 = _CoreCompression.int8
+    Compression.int4 = _CoreCompression.int4
+
+
+_quant_markers()
 
 
 # handle -> (in-place target or None, caller dtype to restore).
@@ -158,11 +174,12 @@ def _result_tensor(handle: int, result) -> torch.Tensor:
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
-                    process_set=None) -> int:
+                    process_set=None, compression=None) -> int:
     h = _core.allreduce_async(_to_np(tensor), average, name, op=op,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
-                              process_set=process_set)
+                              process_set=process_set,
+                              compression=compression)
     _handle_meta[h] = (None, tensor.dtype)
     return h
 
@@ -390,13 +407,18 @@ def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none,
               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
     t, ctx = compression.compress(tensor)
+    # quant markers ride to the runtime as the wire format; compress()
+    # above was identity for them (autograd-tracked tensors keep the
+    # uncompressed wire — the backward collective has no marker to match)
+    qm = (compression if getattr(compression, "quant_spec", None)
+          is not None else None)
     if _grad_wanted(t):
         out = _AllreduceOp.apply(t, average, name, op, prescale_factor,
                                  postscale_factor, process_set)
     else:
         out = synchronize(allreduce_async(t, average, name, op,
                                           prescale_factor, postscale_factor,
-                                          process_set))
+                                          process_set, compression=qm))
     return compression.decompress(out, ctx)
 
 
@@ -605,10 +627,13 @@ class _DistributedMixin:
                     process_set=self._process_set)
                 return
         comp, ctx = self._compression.compress(grad)
+        qm = (self._compression
+              if getattr(self._compression, "quant_spec", None) is not None
+              else None)
         h = allreduce_async(comp, name=self._names[p], op=self._op,
                             prescale_factor=self._prescale,
                             postscale_factor=self._postscale,
-                            process_set=self._process_set)
+                            process_set=self._process_set, compression=qm)
         self._handles[p] = (h, ctx)
 
     def synchronize(self):

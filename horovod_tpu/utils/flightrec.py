@@ -48,6 +48,7 @@ CATEGORIES = (
     ("probe_verdict", "backend liveness probe decided"),
     ("watchdog", "wedge watchdog fired"),
     ("diag_dump", "diagnostic bundle written"),
+    ("quant_fallback", "tensor kept off the quantized wire"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)
