@@ -452,6 +452,15 @@ def main():
         strag = trep.get("straggler")
         if strag:
             extras["trace_straggler"] = strag
+    # Per-step phase/goodput decomposition when HOROVOD_PERFLEDGER is on
+    # (docs/observability.md "Performance ledger"). Same None-when-off
+    # convention as the quant/sharded extras: absent ledger reads None,
+    # so the driver's trend tooling can tell "off" from "zero".
+    prep = hvd.perf_report()
+    pstats = prep.get("stats", {}) if prep.get("enabled") else {}
+    extras["perf_exposed_comm_frac"] = pstats.get("exposed_comm_frac")
+    extras["perf_negotiate_p95_ms"] = pstats.get("negotiate_p95_ms")
+    extras["perf_step_wire_bytes"] = pstats.get("step_wire_bytes")
     if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
         # honest metadata: this run is the forced-CPU fallback because the
         # TPU child failed/hung (wedged tunnel) — numbers are NOT chip
@@ -592,6 +601,23 @@ def _emit_result(stdout_text: str, stderr_text: str = "") -> bool:
     for ln in leftover[-3:]:
         sys.stderr.write(ln[:200] + "\n")
     sys.stderr.flush()
+    # Regression guard (tools/benchguard): judge this result against the
+    # banked BENCH_r*.json trajectory and bank the verdict in extras.
+    # Advisory here — the bench must emit its measurement even when it
+    # regressed (the driver's tail parse and the benchguard CLI are the
+    # enforcing paths), so a guard failure only logs.
+    try:
+        from tools.benchguard import compare, load_history
+        doc = json.loads(json_line)
+        hist = load_history(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json"))
+        verdict = compare(doc, hist)
+        doc.setdefault("extras", {})["benchguard"] = {
+            k: verdict.get(k)
+            for k in ("status", "baseline", "ratio", "violations")}
+        json_line = json.dumps(doc)
+    except Exception as e:
+        sys.stderr.write(f"benchguard verdict skipped: {e}\n")
     _write_result_file(json_line)
     sys.stdout.write(json_line + "\n")
     sys.stdout.flush()

@@ -10,7 +10,17 @@ coordinator thread, exactly as in production), run R identical
 single-tensor rounds plus R SAME_AS_LAST rounds, report µs/round and
 bytes/round. Output: a markdown table + one JSON line per np.
 
+Budgeted mode (ROADMAP item 3's scaling gate, wired as a slow tier-1
+test in tests/test_perfledger.py): ``--budget`` simulates a pod-scale
+world — N (default 64) KVController instances on N in-process threads
+against one real HTTP store, the same wire protocol with thread-level
+instead of process-level concurrency — and asserts the negotiation-round
+p95 against a static bound through tools.benchguard's compare engine
+(exit 1 on breach, same contract as ``python -m tools.benchguard``).
+
 Usage: python benchmarks/controller_scaling.py [rounds]
+       python benchmarks/controller_scaling.py --budget [--ranks 64]
+           [--rounds 30] [--p95-ms 500] [--json]
 """
 
 import json
@@ -79,7 +89,127 @@ def measure(nproc: int, rounds: int) -> dict:
     return res
 
 
+def simulate(nranks: int, rounds: int,
+             timeout_s: float = 240.0) -> dict:
+    """Pod-scale negotiation simulation in one process.
+
+    ``nranks`` KVController instances on ``nranks`` threads share one
+    real RendezvousServer — the full wire protocol (puts, long-poll
+    GETs, SAME_AS_LAST fast path, coordinator thread on rank 0) with
+    thread-level instead of process-level workers, which is what lets a
+    1-CPU CI host exercise a 64-rank round. Negotiation is IO-bound
+    (HTTP long-polls release the GIL), so the protocol cost still
+    dominates the number. Returns rank 0's per-round latency stats.
+    """
+    import threading
+
+    from horovod_tpu.ops.controller import KVController
+    from horovod_tpu.runner.http_server import (KVStoreClient,
+                                                RendezvousServer)
+
+    srv = RendezvousServer()
+    port = srv.start()
+    sig = ["allreduce", "float32", [1024], 0, -1, 1.0, 1.0, "global",
+           "host"]
+    lat_s: list = []   # rank 0's per-round negotiate wall seconds
+    errs: list = []
+
+    def run(rank: int):
+        ctl = None
+        try:
+            ctl = KVController(KVStoreClient("127.0.0.1", port), rank,
+                               nranks, poll_timeout=timeout_s)
+            ctl.negotiate({"warm": sig})  # scope setup / thread spin-up
+            for i in range(rounds):
+                t0 = time.perf_counter()
+                resp = ctl.negotiate({f"t{i}": sig})
+                if rank == 0:
+                    lat_s.append(time.perf_counter() - t0)
+                assert resp["ready"] == [f"t{i}"], resp
+        except Exception as e:  # surfaced after join — a wedged rank
+            errs.append((rank, repr(e)))  # must fail the run, not hang it
+        finally:
+            if ctl is not None:
+                try:
+                    ctl.stop()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True,
+                                name=f"sim-rank{r}")
+               for r in range(nranks)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.join(timeout=max(0.5, deadline - time.monotonic()))
+    hung = [t.name for t in threads if t.is_alive()]
+    srv.stop()
+    if hung:
+        raise RuntimeError(f"simulated ranks wedged: {hung}")
+    if errs:
+        raise RuntimeError(f"simulated ranks failed: {errs[:4]}")
+    lat_ms = sorted(v * 1e3 for v in lat_s)
+    n = len(lat_ms)
+    return {
+        "ranks": nranks,
+        "rounds": rounds,
+        "negotiate_p50_ms": round(lat_ms[(n - 1) // 2], 3),
+        "negotiate_p95_ms": round(
+            lat_ms[min(n - 1, round(0.95 * (n - 1)))], 3),
+        "negotiate_max_ms": round(lat_ms[-1], 3),
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }
+
+
+def budget_main(argv) -> int:
+    """``--budget`` mode: assert the simulated-pod negotiation p95
+    against a static bound via tools.benchguard (exit-code contract:
+    0 within budget, 1 breached)."""
+    import argparse
+
+    from tools.benchguard import compare, exit_code
+
+    ap = argparse.ArgumentParser(
+        prog="controller_scaling --budget",
+        description="pod-scale negotiation latency budget gate")
+    ap.add_argument("--ranks", type=int, default=64,
+                    help="simulated world size (default 64)")
+    ap.add_argument("--rounds", type=int, default=30,
+                    help="measured rounds at rank 0 (default 30)")
+    ap.add_argument("--p95-ms", type=float, default=500.0,
+                    help="negotiation p95 budget in ms (default 500: "
+                         "~9x the quiet-host p95 at 64 simulated ranks "
+                         "(~57 ms), so a protocol regression toward "
+                         "O(size) polling trips it while a loaded CI "
+                         "host does not)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    stats = simulate(args.ranks, args.rounds)
+    result = {"metric": "controller_sim_negotiate_p95_ms",
+              "value": stats["negotiate_p95_ms"], "unit": "ms",
+              "extras": stats}
+    verdict = compare(result, history=[],
+                      budgets=[("value", "<=", args.p95_ms)])
+    out = {"result": result, "verdict": verdict}
+    if args.as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print(f"controller_scaling: {verdict['status'].upper()} — "
+              f"negotiate p95 {stats['negotiate_p95_ms']:g} ms over "
+              f"{args.ranks} simulated ranks (budget "
+              f"<={args.p95_ms:g} ms)")
+        for v in verdict["violations"]:
+            print(f"  violation: {v}", file=sys.stderr)
+    return exit_code(verdict)
+
+
 def main():
+    if "--budget" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--budget"]
+        sys.exit(budget_main(argv))
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     mp.set_start_method("spawn", force=True)
     print("| np | negotiate µs/round | steady-state µs/round "

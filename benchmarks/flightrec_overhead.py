@@ -22,9 +22,7 @@ tests/test_flightrec.py does, with small cycle counts and a loose bound,
 so a hot-path regression surfaces in CI rather than on a chip window).
 """
 
-import json
 import os
-import statistics
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -34,11 +32,10 @@ sys.path.insert(0, os.path.dirname(_HERE))
 if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
     sys.path.insert(1, _HERE)
 
+import _common  # noqa: E402  (benchmarks/ sibling)
 import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
 
-# A/A runs of the same config differ by a few percent on a shared CI
-# host; the off-vs-baseline check allows noise_ratio + this margin.
-NOISE_MARGIN = 0.02
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
 
 
 def measure_flightrec(flightrec_on: bool, cycles: int = 50,
@@ -67,52 +64,12 @@ def measure_flightrec(flightrec_on: bool, cycles: int = 50,
 
 
 def main() -> int:
-    # Discard one full run first: the process's first pass pays jax
-    # compile-cache population, which would otherwise read as "overhead"
-    # on whichever config happens to go first.
-    measure_flightrec(flightrec_on=False, cycles=10, warmup=2)
     # Two recorder-off configs establish the A/A noise floor on this
     # host; recorder-off must sit within that floor (+ margin) of the
     # baseline, because with the recorder None the two runs execute
-    # identical code. The configs are INTERLEAVED across the best-of-5
-    # reps (A A' B, A A' B, ...) rather than run as sequential blocks:
-    # allocator/CPU-frequency warm-up drifts monotonically over a fresh
-    # process's first seconds, and a block layout aliases that drift
-    # into a fake A-vs-A difference.
-    runs = {"baseline": [], "off": [], "on": []}
-    for _ in range(5):
-        runs["baseline"].append(measure_flightrec(flightrec_on=False))
-        runs["off"].append(measure_flightrec(flightrec_on=False))
-        runs["on"].append(measure_flightrec(flightrec_on=True))
-
-    # Paired per-rep ratios, then the median across reps: a rep's three
-    # runs execute back-to-back in the same host state, so the ratio
-    # cancels the slower drift (frequency scaling, noisy-neighbour load)
-    # that interleaving alone cannot, and the median drops hiccup reps.
-    def ratios(config):
-        return [r["dispatch_ms_median"] / b["dispatch_ms_median"]
-                for r, b in zip(runs[config], runs["baseline"])]
-
-    noise = abs(statistics.median(ratios("off")) - 1.0)
-    on_over = statistics.median(ratios("on"))
-    baseline, off, on = (
-        min(runs[k], key=lambda r: r["dispatch_ms_median"])
-        for k in ("baseline", "off", "on"))
-    ok = noise <= NOISE_MARGIN
-    print(json.dumps({
-        "baseline": baseline,
-        "flightrec_off": off,
-        "flightrec_on": on,
-        "off_vs_baseline_noise": round(noise, 4),
-        "off_within_noise_bound": ok,
-        "noise_bound": NOISE_MARGIN,
-        "on_over_baseline": round(on_over, 3),
-    }))
-    if not ok:
-        print(f"FAIL: flightrec-off differs from baseline by "
-              f"{noise:.1%} > {NOISE_MARGIN:.0%}", file=sys.stderr)
-        return 1
-    return 0
+    # identical code. Interleaving/pairing rationale lives in
+    # _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_flightrec, "flightrec")
 
 
 if __name__ == "__main__":

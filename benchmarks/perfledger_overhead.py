@@ -1,0 +1,75 @@
+"""Perf-ledger overhead on the background cycle loop (pure CPU).
+
+Enforces the zero-cost contract of horovod_tpu/utils/perfledger.py: with
+``HOROVOD_PERFLEDGER`` unset no ledger exists and the cycle loop pays
+one ``is None`` check per phase stamp, so the ledger-off build must sit
+inside measurement noise of the pre-ledger baseline (the ISSUE 9 A/A
+acceptance gate: within 2%) — and the ledger-on build (four
+perf_counter reads, counter-delta reads, one ring append per working
+cycle) must stay bounded, not free.
+
+Reuses the cycle_overhead.py harness (same synthetic 20-tensor fused
+workload, same inline ``run_cycle()`` timing) through the shared A/A
+harness in _common.py; the only variable here is the process ledger's
+presence.
+
+Run directly for a JSON line:
+
+    JAX_PLATFORMS=cpu python benchmarks/perfledger_overhead.py
+
+or import ``measure_perfledger()`` (the tier-1 smoke test in
+tests/test_perfledger.py does, with small cycle counts and a loose
+bound, so a hot-path regression surfaces in CI rather than on a chip
+window).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
+    sys.path.insert(1, _HERE)
+
+import _common  # noqa: E402  (benchmarks/ sibling)
+import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
+
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
+
+
+def measure_perfledger(ledger_on: bool, cycles: int = 50,
+                       warmup: int = 5) -> dict:
+    """cycle_overhead.measure (plans enabled) with the process perf
+    ledger toggled for the runtime under test. Restores the ledger-less
+    state on exit so callers / later tests see the default."""
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.utils import perfledger as perfledger_mod
+
+    try:
+        if ledger_on:
+            os.environ[env_schema.HOROVOD_PERFLEDGER] = "1"
+            perfledger_mod.init_ledger(rank=0)
+        else:
+            os.environ.pop(env_schema.HOROVOD_PERFLEDGER, None)
+            perfledger_mod.reset_ledger()
+        out = cycle_overhead.measure(plans_enabled=True, cycles=cycles,
+                                     warmup=warmup)
+    finally:
+        os.environ.pop(env_schema.HOROVOD_PERFLEDGER, None)
+        perfledger_mod.reset_ledger()
+    out["ledger_on"] = ledger_on
+    return out
+
+
+def main() -> int:
+    # Two ledger-off configs establish the A/A noise floor on this host;
+    # ledger-off must sit within that floor (+ margin) of the baseline,
+    # because with the ledger None the two runs execute identical code.
+    # Interleaving/pairing rationale lives in _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_perfledger, "perfledger")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,6 @@ tests/test_tracing.py does, with small cycle counts and a loose bound, so
 a hot-path regression surfaces in CI rather than on a chip window).
 """
 
-import json
 import os
 import sys
 
@@ -31,11 +30,10 @@ sys.path.insert(0, os.path.dirname(_HERE))
 if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
     sys.path.insert(1, _HERE)
 
+import _common  # noqa: E402  (benchmarks/ sibling)
 import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
 
-# A/A runs of the same config differ by a few percent on a shared CI
-# host; the off-vs-baseline check allows noise_ratio + this margin.
-NOISE_MARGIN = 0.02
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
 
 
 def measure_tracing(tracing_on: bool, cycles: int = 50,
@@ -62,42 +60,12 @@ def measure_tracing(tracing_on: bool, cycles: int = 50,
     return out
 
 
-def _best(tracing_on: bool, reps: int = 5, **kw) -> dict:
-    """Best-of-N medians: scheduler hiccups inflate single runs; the
-    minimum median is the stable per-config cost on a shared host."""
-    runs = [measure_tracing(tracing_on, **kw) for _ in range(reps)]
-    return min(runs, key=lambda r: r["dispatch_ms_median"])
-
-
 def main() -> int:
-    # Discard one full run first: the process's first pass pays jax
-    # compile-cache population, which would otherwise read as "overhead"
-    # on whichever config happens to go first.
-    measure_tracing(tracing_on=False, cycles=10, warmup=2)
     # Two tracing-off configs establish the A/A noise floor on this host;
     # tracing-off must sit within that floor (+ margin) of the baseline,
     # because with the tracer None the two runs execute identical code.
-    baseline = _best(tracing_on=False)
-    off = _best(tracing_on=False)
-    on = _best(tracing_on=True)
-    base_ms = baseline["dispatch_ms_median"]
-    noise = abs(off["dispatch_ms_median"] - base_ms) / base_ms
-    on_over = on["dispatch_ms_median"] / base_ms
-    ok = noise <= NOISE_MARGIN
-    print(json.dumps({
-        "baseline": baseline,
-        "tracing_off": off,
-        "tracing_on": on,
-        "off_vs_baseline_noise": round(noise, 4),
-        "off_within_noise_bound": ok,
-        "noise_bound": NOISE_MARGIN,
-        "on_over_baseline": round(on_over, 3),
-    }))
-    if not ok:
-        print(f"FAIL: tracing-off differs from baseline by "
-              f"{noise:.1%} > {NOISE_MARGIN:.0%}", file=sys.stderr)
-        return 1
-    return 0
+    # Interleaving/pairing rationale lives in _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_tracing, "tracing")
 
 
 if __name__ == "__main__":

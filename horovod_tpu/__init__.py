@@ -112,6 +112,20 @@ def trace_report() -> dict:
     return _tracing.report()
 
 
+def perf_report() -> dict:
+    """This rank's per-step performance ledger (utils/perfledger.py):
+    derived goodput stats (negotiate p50/p95, exposed-comm fraction,
+    wire bytes per step, plan hit rate, effective allreduce GB/s), the
+    five-phase step decomposition, and — when ``HOROVOD_SLO_SPEC`` armed
+    the budget engine — each budget's bound and breach state.
+    ``{"enabled": False}`` unless HOROVOD_PERFLEDGER was set at init.
+    The merged cross-rank view is ``GET /perf`` on the launcher's
+    rendezvous server (docs/observability.md)."""
+    from .utils import perfledger as _perfledger
+
+    return _perfledger.report()
+
+
 def diagnose() -> dict:
     """The local diagnostic bundle (utils/diag.py): all-thread stacks,
     lockcheck state, a metrics snapshot, open tracing spans, the flight

@@ -272,6 +272,12 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
         # resolve the recorder/watchdog handles once at build time
         _start_diag()
 
+        # perf ledger BEFORE the runtime construct for the same reason;
+        # the SLO engine attaches the stall inspector below once it exists
+        from ..utils import perfledger as perfledger_mod
+
+        perfledger_mod.init_ledger(rank=_ctx.global_set.cross_rank)
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)
@@ -301,6 +307,11 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 shutdown_time_s=_ctx.config.stall_shutdown_time_s,
                 disabled=_ctx.config.stall_check_disable,
             )
+            # idempotent: hands the inspector to an already-armed SLO
+            # engine so breach escalations carry straggler attribution
+            perfledger_mod.init_ledger(
+                rank=_ctx.global_set.cross_rank,
+                stall_inspector=_ctx.stall_inspector)
             _ctx.runtime = BackgroundRuntime(
                 _ctx.global_set,
                 config=_ctx.config,

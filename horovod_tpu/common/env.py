@@ -110,6 +110,14 @@ HOROVOD_FLIGHTREC = "HOROVOD_FLIGHTREC"
 HOROVOD_FLIGHTREC_BUFFER = "HOROVOD_FLIGHTREC_BUFFER"
 HOROVOD_WATCHDOG_SECS = "HOROVOD_WATCHDOG_SECS"
 HOROVOD_DIAG_DIR = "HOROVOD_DIAG_DIR"
+# per-step performance ledger + SLO budget engine (utils/perfledger.py;
+# docs/observability.md "Performance ledger & SLO budgets"): master
+# switch, per-step record-ring capacity, and the declarative budget spec
+# — either the inline grammar ("negotiate_p95_ms<=5,plan_hit_rate>=0.95")
+# or a JSON object / path to a JSON file mapping stat name to bound
+HOROVOD_PERFLEDGER = "HOROVOD_PERFLEDGER"
+HOROVOD_PERFLEDGER_BUFFER = "HOROVOD_PERFLEDGER_BUFFER"
+HOROVOD_SLO_SPEC = "HOROVOD_SLO_SPEC"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -226,6 +234,11 @@ class RuntimeConfig:
     flightrec_buffer: int = 2048
     watchdog_secs: float = 0.0
     diag_dir: str = ""
+    # per-step performance ledger + SLO budgets (utils/perfledger.py) —
+    # off by default (zero-cost contract: no hvd_perf_*/hvd_slo_* series)
+    perfledger_enabled: bool = False
+    perfledger_buffer: int = 1024
+    slo_spec: str = ""
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -278,4 +291,8 @@ class RuntimeConfig:
                                      c.flightrec_buffer)
         c.watchdog_secs = get_float(HOROVOD_WATCHDOG_SECS, c.watchdog_secs)
         c.diag_dir = get_str(HOROVOD_DIAG_DIR)
+        c.perfledger_enabled = get_bool(HOROVOD_PERFLEDGER)
+        c.perfledger_buffer = get_int(HOROVOD_PERFLEDGER_BUFFER,
+                                      c.perfledger_buffer)
+        c.slo_spec = get_str(HOROVOD_SLO_SPEC)
         return c

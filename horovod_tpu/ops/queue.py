@@ -48,6 +48,7 @@ from ..utils import diag as diag_mod
 from ..utils import flightrec as flightrec_mod
 from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
+from ..utils import perfledger as perfledger_mod
 from ..utils import tracing as tracing_mod
 from . import collectives as C
 from . import compression as compression_mod
@@ -247,6 +248,15 @@ class BackgroundRuntime:
         # loop and negotiation bracket at one is-None check each
         self.recorder = flightrec_mod.get_recorder()
         self.watchdog = diag_mod.get_watchdog()
+        # per-step performance ledger, same resolved-once contract
+        # (benchmarks/perfledger_overhead.py): a None handle keeps the
+        # cycle loop at one is-None check per phase stamp
+        self.ledger = perfledger_mod.get_ledger()
+        # per-cycle scratch the ledger hooks accumulate into (cycle
+        # thread only): execute-window seconds and the round's worst
+        # coordinator straggler verdict
+        self._perf_exec_s = 0.0
+        self._perf_strag: Optional[tuple] = None
         # blockwise quantized wire (ops/compression.py): resolved ONCE.
         # None keeps every quant hook below at a single is-None/or check —
         # the zero-cost contract (tests/test_quantized.py asserts no
@@ -453,6 +463,11 @@ class BackgroundRuntime:
         self.cycles += 1
         batch = self.queue.drain()
         cycle_t0 = time.perf_counter()
+        led = self.ledger
+        t_neg = t_disp = 0.0
+        if led is not None:
+            self._perf_exec_s = 0.0
+            self._perf_strag = None
         if batch:
             self._m_queue_depth.set(len(batch))
             if self.tracer is not None:
@@ -491,7 +506,10 @@ class BackgroundRuntime:
                     if entry is not None:
                         self._finish(entry, None, err)
         if self.controller is not None:
+            _pt = time.perf_counter() if led is not None else 0.0
             batch = self._negotiate(batch)
+            if led is not None:
+                t_neg = time.perf_counter() - _pt
         elif self.process_set.cross_size > 1 and batch:
             # no rendezvous store: best-effort deterministic order
             batch.sort(key=lambda e: e.name)
@@ -528,11 +546,20 @@ class BackgroundRuntime:
                 fusable.setdefault(key, []).append(e)
             else:
                 singles.append(e)
+        if led is not None:
+            _pt = time.perf_counter()
         for key, group in fusable.items():
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
-        self._m_cycle.observe(time.perf_counter() - cycle_t0)
+        if led is not None:
+            t_disp = time.perf_counter() - _pt
+        wall = time.perf_counter() - cycle_t0
+        self._m_cycle.observe(wall)
+        if led is not None:
+            led.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
+                            exec_s=self._perf_exec_s, tensors=len(batch),
+                            straggler=self._perf_strag)
         # autotune sampling on working cycles (reference: ParameterManager
         # scores each cycle's bytes/sec, parameter_manager.h:88)
         self.work_cycles += 1
@@ -603,6 +630,12 @@ class BackgroundRuntime:
                 self._finish(e, None, HorovodInternalError(msg))
         out = []
         strag = resp.get("strag") or {}
+        if self.ledger is not None and strag:
+            # worst verdict this round feeds the step record's straggler
+            # field (the ledger decides whether it counts as stall)
+            self._perf_strag = max(
+                ((int(r), float(w)) for r, w in strag.values()),
+                key=lambda rw: rw[1])
         neg_end = time.time() if self.tracer is not None else 0.0
         for n in ready:
             if n in self._pending:
@@ -816,11 +849,15 @@ class BackgroundRuntime:
                             e.span.t[tracing_mod.T_DISPATCH_START] = disp0
                             e.span.chunk_bytes = total_bytes
                             e.span.chunk_tensors = len(chunk)
+                if self.ledger is not None:
+                    _xt = time.perf_counter()
                 if plan is not None:
                     parts = self._dispatch_plan(plan, arrs, on_dev)
                 else:
                     parts = self._dispatch_legacy(arrs, on_dev, e0, ps,
                                                   sizes, shapes)
+                if self.ledger is not None:
+                    self._perf_exec_s += time.perf_counter() - _xt
                 if self.tracer is not None:
                     disp1 = time.time()
                     for e in chunk:

@@ -35,6 +35,34 @@ from ..utils import retry as _retry
 from . import secret as _secret
 
 
+#: A pushed snapshot whose freshness stamp lags the newest push by more
+#: than this many publisher intervals is annotated stale (the floor
+#: absorbs dumper-thread jitter between healthy ranks).
+STALE_INTERVALS = 3
+STALE_FLOOR_S = 15.0
+
+
+def _stale_ranks(entries) -> set:
+    """Which of ``[(rank, snap), ...]`` are serving old news: their
+    ``push_ts`` lags the newest push by more than ``STALE_INTERVALS``
+    publisher intervals. Snapshots without a stamp (pre-stamp pushers)
+    cannot be judged and are never marked."""
+    stamped = [(r, s) for r, s in entries
+               if isinstance(s.get("push_ts"), (int, float))]
+    if len(stamped) < 2:
+        return set()
+    newest = max(s["push_ts"] for _, s in stamped)
+    out = set()
+    for r, s in stamped:
+        interval = s.get("push_interval_s")
+        if not isinstance(interval, (int, float)) or interval <= 0:
+            interval = 30.0
+        if newest - s["push_ts"] > max(STALE_INTERVALS * interval,
+                                       STALE_FLOOR_S):
+            out.add(r)
+    return out
+
+
 class KVAuthError(RuntimeError):
     """A KV exchange failed authentication: either the store refused our
     digest (key mismatch / tampered request) or a GET response's digest
@@ -101,6 +129,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_timeline()
         if key == "debug":
             return self._do_debug()
+        if key == "perf":
+            return self._do_perf()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -168,8 +198,15 @@ class _KVHandler(BaseHTTPRequestHandler):
         if worker:
             newest = max(_gen(s) for _, s in worker)
             worker = [(r, s) for r, s in worker if _gen(s) == newest]
+        # freshness: a wedged rank's dumper stops pushing, but its last
+        # snapshot survives in the store and passes the generation filter
+        # above. Annotate (never drop — the frozen numbers ARE the
+        # evidence) every rank whose push stamp lags the newest push.
+        stale = _stale_ranks(worker)
         snaps = [({}, metrics_mod.get_registry().snapshot())]
-        snaps.extend(({"rank": r}, s) for r, s in worker)
+        snaps.extend(
+            ({"rank": r, "stale": "1"} if r in stale else {"rank": r}, s)
+            for r, s in worker)
         body = metrics_mod.render_snapshots(snaps).encode()
         self.send_response(200)
         self.send_header("Content-Type",
@@ -255,6 +292,48 @@ class _KVHandler(BaseHTTPRequestHandler):
             except (ValueError, UnicodeDecodeError):
                 continue  # half-written push: skip, next poll catches up
         body = json.dumps(diag_mod.merge_bundles(bundles)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_perf(self):
+        """``GET /perf``: merge every per-step performance-ledger snapshot
+        ranks pushed under the ``perf/`` KV scope (utils/perfledger.py)
+        into one JSON view — per rank: derived goodput stats, the
+        five-phase step decomposition, the newest raw records, and a
+        ``stale`` flag when that rank's push stamp lags the newest push
+        (same annotate-don't-drop policy as ``/metrics``). Auth-exempt
+        read-only telemetry, same rationale as ``/metrics``."""
+        import json
+
+        from ..utils import perfledger as perfledger_mod
+
+        store = self.server.store  # type: ignore[attr-defined]
+        scope_prefix = perfledger_mod.KV_SCOPE + "/"
+        with store.cond:
+            pushed = {k: v for k, v in store.data.items()
+                      if k.startswith(scope_prefix)}
+        entries = []
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank1"
+            rank = suffix[4:] if suffix.startswith("rank") else suffix
+            try:
+                entries.append((rank, json.loads(v)))
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+        stale = _stale_ranks(entries)
+        ranks = {}
+        for rank, snap in entries:
+            snap["stale"] = rank in stale
+            ranks[rank] = snap
+        local = perfledger_mod.get_ledger()
+        if local is not None and str(local.rank) not in ranks:
+            snap = local.snapshot()
+            snap["stale"] = False
+            ranks[str(local.rank)] = snap
+        body = json.dumps({"ranks": ranks}).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

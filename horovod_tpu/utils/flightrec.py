@@ -49,6 +49,7 @@ CATEGORIES = (
     ("watchdog", "wedge watchdog fired"),
     ("diag_dump", "diagnostic bundle written"),
     ("quant_fallback", "tensor kept off the quantized wire"),
+    ("slo_breach", "declared SLO budget crossed its bound"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

@@ -93,6 +93,15 @@ class StallInspector:
                 f"{name!r} (peers waited {wait_s:.3f} s); suspect that "
                 "rank first.")
 
+    def note_slo_breach(self, budget: str, detail: str):
+        """Escalate an SLO-budget breach (utils/perfledger.py) through the
+        same warning path a stalled tensor takes — the breach names the
+        violated budget and, when the coordinator attributed a recent
+        straggler, the suspect rank."""
+        LOG.warning("SLO budget %r breached: %s.%s", budget, detail,
+                    self._suspect())
+        self._m_warnings.inc()
+
     def check(self):
         """Called once per background cycle (reference: invoked from
         ComputeResponseList, controller.cc:294)."""

@@ -296,11 +296,19 @@ def test_bench_parent_json_survives_stderr_flood(monkeypatch, capsys, tmp_path):
     assert bench._parent_main() == 0
     cap = capsys.readouterr()
     combined = cap.err + cap.out  # stderr excerpt first, JSON last
-    assert combined[-500:].rstrip().endswith(json_line)
-    assert cap.out.rstrip().splitlines()[-1] == json_line
+    # the emitted line is the child's measurement plus the benchguard
+    # verdict banked under extras — it must still parse from the final
+    # 500 bytes and agree with the child's numbers
+    tail_line = combined[-500:].rstrip().rsplit("\n", 1)[-1]
+    doc = _json.loads(tail_line)
+    want = _json.loads(json_line)
+    assert doc["metric"] == want["metric"] and doc["value"] == want["value"]
+    assert doc["extras"]["device"] == "fake"
+    assert "status" in doc["extras"]["benchguard"]
+    assert cap.out.rstrip().splitlines()[-1] == tail_line
     assert len(cap.err) < 1000  # the flood was capped, not forwarded
     with open(tmp_path / "bench_result.json") as f:
-        assert _json.loads(f.read()) == _json.loads(json_line)
+        assert _json.loads(f.read()) == doc
 
 
 def test_bench_parent_fallback_emits_parseable_json(monkeypatch, capsys, tmp_path):

@@ -1,0 +1,201 @@
+"""tools/benchguard: the bench-trajectory regression guard.
+
+Covers the CLI exit-code contract (0 ok / 1 regression-or-budget /
+2 no-history / 3 malformed), the lower-median baseline policy over the
+real banked BENCH_r*.json shape (wrapped ``parsed``, null-parse rounds
+skipped), static budgets with dotted extras paths, direction inference,
+and the ``guard()`` convenience bench.py banks its verdict through.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools import benchguard  # noqa: E402
+from tools.benchguard import __main__ as bg_cli  # noqa: E402
+
+METRIC = "resnet50_images_per_sec_per_chip"
+
+
+def _bank(tmp_path, n, value, metric=METRIC):
+    """One BENCH_r{n}.json wrapper, the driver's banked shape."""
+    doc = {"n": n, "cmd": "python bench.py", "rc": 0, "tail": "",
+           "parsed": None if value is None else
+           {"metric": metric, "value": value, "unit": "images/sec/chip",
+            "mfu": 0.1, "vs_baseline": 1.0}}
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def _result(tmp_path, value, metric=METRIC, name="result.json", extras=None):
+    doc = {"metric": metric, "value": value, "unit": "images/sec/chip"}
+    if extras is not None:
+        doc["extras"] = extras
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return path
+
+
+@pytest.fixture
+def history(tmp_path):
+    # the real trajectory's shape: one early outlier under a different
+    # measurement convention, two wedged rounds (parsed: null), then the
+    # settled regime
+    _bank(tmp_path, 1, 2241.08)
+    _bank(tmp_path, 2, None)
+    _bank(tmp_path, 3, None)
+    _bank(tmp_path, 4, 0.65)
+    _bank(tmp_path, 5, 0.62)
+    return str(tmp_path / "BENCH_r*.json")
+
+
+# --- exit-code contract (the 5 CLI cases) ------------------------------------
+
+def test_cli_exit_0_on_improvement(tmp_path, history, capsys):
+    rc = bg_cli.main([str(_result(tmp_path, 0.80)), "--history", history])
+    assert rc == benchguard.EXIT_OK
+    assert "OK" in capsys.readouterr().out
+
+
+def test_cli_exit_0_within_tolerance(tmp_path, history):
+    # lower median of [2241.08, 0.65, 0.62] is 0.65; 0.60 is a 7.7%
+    # slip, inside the 10% tolerance
+    rc = bg_cli.main([str(_result(tmp_path, 0.60)), "--history", history])
+    assert rc == benchguard.EXIT_OK
+
+
+def test_cli_exit_1_on_regression(tmp_path, history, capsys):
+    rc = bg_cli.main([str(_result(tmp_path, 0.30)), "--history", history,
+                      "--json"])
+    assert rc == benchguard.EXIT_REGRESSION
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["status"] == "regression"
+    assert verdict["baseline"] == 0.65  # lower median, not the outlier
+    assert verdict["violations"]
+
+
+def test_cli_exit_2_without_history_or_budgets(tmp_path):
+    rc = bg_cli.main([str(_result(tmp_path, 0.65)), "--history",
+                      str(tmp_path / "nope_r*.json")])
+    assert rc == benchguard.EXIT_NO_HISTORY
+
+
+def test_cli_exit_3_on_malformed_result(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{half a json")
+    rc = bg_cli.main([str(bad), "--json"])
+    assert rc == benchguard.EXIT_MALFORMED
+    assert json.loads(capsys.readouterr().out)["status"] == "malformed"
+    # a result with no numeric value is equally unjudgeable
+    novalue = tmp_path / "novalue.json"
+    novalue.write_text(json.dumps({"metric": METRIC, "value": None}))
+    assert bg_cli.main([str(novalue)]) == benchguard.EXIT_MALFORMED
+
+
+# --- comparison policy -------------------------------------------------------
+
+def test_lower_median_rides_out_the_outlier_round(tmp_path, history):
+    """The r01 outlier (2241 vs the settled ~0.65 regime) must not drag
+    the baseline: a fresh 0.62 is OK, and even a true mean/upper-median
+    would have called everything after r01 a catastrophic regression."""
+    result = benchguard.load_result(str(_result(tmp_path, 0.62)))
+    hist = benchguard.load_history(history)
+    verdict = benchguard.compare(result, hist)
+    assert verdict["status"] == "ok"
+    assert verdict["baseline"] == 0.65
+    # the two null-parse rounds are dropped at load: they carry no signal
+    assert verdict["history_total"] == 3
+    assert verdict["history_comparable"] == 3
+
+
+def test_mismatched_metric_names_do_not_compare(tmp_path, history):
+    other = benchguard.load_result(
+        str(_result(tmp_path, 1.0, metric="other_images_per_sec")))
+    verdict = benchguard.compare(other, benchguard.load_history(history))
+    assert verdict["status"] == "no-history"
+    assert verdict["history_comparable"] == 0
+
+
+def test_direction_inference_and_override(tmp_path):
+    assert benchguard.resolve_direction("negotiate_p95_ms") == "lower"
+    assert benchguard.resolve_direction("images_per_sec") == "higher"
+    assert benchguard.resolve_direction("images_per_sec", "lower") == "lower"
+    # a latency metric going UP beyond tolerance is the regression
+    hist_path = tmp_path / "h"
+    hist_path.mkdir()
+    for n, v in ((1, 100.0), (2, 102.0), (3, 98.0)):
+        _bank(hist_path, n, v, metric="round_latency_ms")
+    hist = benchguard.load_history(str(hist_path / "BENCH_r*.json"))
+    result = benchguard.load_result(
+        str(_result(tmp_path, 150.0, metric="round_latency_ms")))
+    verdict = benchguard.compare(result, hist)
+    assert verdict["direction"] == "lower"
+    assert verdict["status"] == "regression"
+    ok = benchguard.load_result(
+        str(_result(tmp_path, 101.0, metric="round_latency_ms",
+                    name="ok.json")))
+    assert benchguard.compare(ok, hist)["status"] == "ok"
+
+
+def test_static_budgets_with_dotted_extras(tmp_path):
+    budgets_path = tmp_path / "budgets.json"
+    budgets_path.write_text(json.dumps(
+        {"value": ">=0.5", "extras.perf_negotiate_p95_ms": "<=50"}))
+    budgets = benchguard.load_budgets(str(budgets_path))
+    ok = benchguard.load_result(str(_result(
+        tmp_path, 0.65, extras={"perf_negotiate_p95_ms": 4.2})))
+    verdict = benchguard.compare(ok, [], budgets=budgets)
+    assert verdict["status"] == "ok"  # budgets alone judge: not exit 2
+    slow = benchguard.load_result(str(_result(
+        tmp_path, 0.65, name="slow.json",
+        extras={"perf_negotiate_p95_ms": 90.0})))
+    verdict = benchguard.compare(slow, [], budgets=budgets)
+    assert verdict["status"] == "regression"
+    assert any("perf_negotiate_p95_ms" in v for v in verdict["violations"])
+    # a budget naming a missing field is a violation, not a silent pass
+    bare = benchguard.load_result(str(_result(tmp_path, 0.65,
+                                              name="bare.json")))
+    verdict = benchguard.compare(bare, [], budgets=budgets)
+    assert verdict["status"] == "regression"
+    assert any("no numeric" in v for v in verdict["violations"])
+    # malformed budgets are CLI exit 3
+    bad = tmp_path / "badb.json"
+    bad.write_text(json.dumps({"value": "approximately 5"}))
+    with pytest.raises(benchguard.MalformedInput):
+        benchguard.load_budgets(str(bad))
+
+
+def test_history_sorted_by_round_and_window(tmp_path):
+    # only the newest --window rounds form the baseline: an ancient
+    # regime must age out of the comparison
+    for n, v in ((1, 9.0), (2, 9.0), (3, 1.0), (4, 1.0), (5, 1.0),
+                 (6, 1.0), (7, 1.0)):
+        _bank(tmp_path, n, v, metric="throughput")
+    hist = benchguard.load_history(str(tmp_path / "BENCH_r*.json"))
+    assert [v for _, v in
+            [(p, d["value"]) for p, d in hist]] == \
+        [9.0, 9.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+    result = benchguard.load_result(
+        str(_result(tmp_path, 0.95, metric="throughput")))
+    verdict = benchguard.compare(result, hist, window=5)
+    assert verdict["baseline"] == 1.0
+    assert verdict["baseline_window"] == [1.0] * 5
+    assert verdict["status"] == "ok"
+
+
+def test_guard_folds_malformed_into_verdict(tmp_path, history):
+    """bench.py's one-call form must never raise — the bench banks its
+    measurement whether or not the guard can judge it."""
+    verdict = benchguard.guard(str(tmp_path / "missing.json"),
+                               history_pattern=history)
+    assert verdict["status"] == "malformed" and verdict["violations"] == []
+    ok = benchguard.guard(str(_result(tmp_path, 0.64)),
+                          history_pattern=history)
+    assert ok["status"] == "ok" and ok["baseline"] == 0.65

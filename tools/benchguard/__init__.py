@@ -1,0 +1,240 @@
+"""benchguard: bench-trajectory regression guard (stdlib only).
+
+Every bench round banks one ``BENCH_r{n}.json`` artifact; until now a
+regressed round banked just as silently as a good one. benchguard
+compares a fresh result against that trajectory (and optional static
+budgets) and fails loudly:
+
+    python -m tools.benchguard result.json --history 'BENCH_r*.json' \
+        [--budgets budgets.json] [--json]
+
+Exit codes (the contract bench.py and the smoke tests rely on):
+
+- 0 — ok: improvement or within tolerance of the trajectory baseline
+  (and every static budget holds)
+- 1 — regression beyond tolerance, or a static budget violated
+- 2 — nothing to compare against: no usable history entries and no
+  budgets given
+- 3 — malformed input: the result file is unreadable/not JSON/carries
+  no numeric value
+
+Comparison policy: history entries are filtered to the result's metric
+name with a numeric, nonzero value (rounds that wedged bank
+``parsed: null`` — they carry no signal and are skipped). The baseline
+is the *lower median* of the newest ``--window`` comparable values —
+the lower median (not the interpolating mean-of-middles) keeps one
+early outlier round from dragging the baseline across a regime shift
+(BENCH_r01 banked 2241 img/s under a convention later rounds measure
+as ~0.65). Direction is inferred from the metric name (``*_ms`` /
+``*_seconds`` / ``*_latency*`` are lower-is-better) unless overridden.
+
+This module is deliberately import-light (json/glob/re only) so the
+CLI works in any interpreter that can read the artifacts — no
+horovod_tpu import, no jax.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import re
+from typing import List, Optional, Tuple
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_NO_HISTORY = 2
+EXIT_MALFORMED = 3
+
+DEFAULT_TOLERANCE = 0.10
+DEFAULT_WINDOW = 5
+
+#: metric-name suffixes that mean "smaller is better" under --direction auto
+_LOWER_IS_BETTER = ("_ms", "_seconds", "_s", "_latency", "_latency_ms",
+                    "_bytes_per_step")
+
+_BOUND_RE = re.compile(r"^\s*(<=|>=)\s*([-+0-9.eE]+)\s*$")
+
+
+class MalformedInput(ValueError):
+    """The result (or budgets) file cannot drive a verdict."""
+
+
+def _unwrap(doc: dict) -> Optional[dict]:
+    """BENCH_r*.json wraps the measurement as ``{"n": ..., "parsed":
+    {...}}``; bench_result.json IS the bare measurement. Returns the
+    measurement dict, or None when the round banked no parse."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc:
+        parsed = doc.get("parsed")
+        return parsed if isinstance(parsed, dict) else None
+    return doc
+
+
+def load_result(path: str) -> dict:
+    """The fresh measurement under guard. Raises :class:`MalformedInput`
+    on unreadable/not-JSON/valueless input (CLI exit 3)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise MalformedInput(f"cannot read {path!r}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise MalformedInput(f"{path!r} is not valid JSON: {e}") from e
+    parsed = _unwrap(doc)
+    if parsed is None or not isinstance(parsed.get("value"), (int, float)):
+        raise MalformedInput(
+            f"{path!r} carries no numeric 'value' to compare")
+    return parsed
+
+
+def load_history(pattern: str) -> List[Tuple[str, dict]]:
+    """Every readable measurement matching the glob, sorted by round
+    number (the ``n`` field when present, else filename). Unreadable or
+    parse-less entries are skipped, not fatal — a wedged past round must
+    not break guarding the present one."""
+    out = []
+    for path in sorted(glob_mod.glob(pattern)):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = _unwrap(doc)
+        if parsed is None:
+            continue
+        n = doc.get("n") if isinstance(doc, dict) else None
+        out.append((n if isinstance(n, int) else 10 ** 9, path, parsed))
+    out.sort(key=lambda t: (t[0], t[1]))
+    return [(path, parsed) for _, path, parsed in out]
+
+
+def load_budgets(path: str) -> List[Tuple[str, str, float]]:
+    """Static bounds: a JSON object mapping a field path (``value``,
+    ``mfu``, or ``extras.<name>``) to a bound string (``"<=5"`` /
+    ``">=0.9"``). Raises :class:`MalformedInput` on anything else."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            obj = json.load(f)
+    except OSError as e:
+        raise MalformedInput(f"cannot read budgets {path!r}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise MalformedInput(
+            f"budgets {path!r} is not valid JSON: {e}") from e
+    if not isinstance(obj, dict):
+        raise MalformedInput(f"budgets {path!r} must be a JSON object")
+    budgets = []
+    for key, bound in obj.items():
+        m = _BOUND_RE.match(str(bound))
+        if m is None:
+            raise MalformedInput(
+                f"budget {key!r}: bound {bound!r} must be <=N or >=N")
+        budgets.append((str(key), m.group(1), float(m.group(2))))
+    return budgets
+
+
+def _field(parsed: dict, path: str):
+    cur = parsed
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _lower_median(values: List[float]) -> float:
+    s = sorted(values)
+    return s[(len(s) - 1) // 2]
+
+
+def resolve_direction(metric: str, direction: str = "auto") -> str:
+    if direction in ("higher", "lower"):
+        return direction
+    name = (metric or "").lower()
+    return "lower" if name.endswith(_LOWER_IS_BETTER) else "higher"
+
+
+def compare(result: dict, history: List[Tuple[str, dict]],
+            budgets: Optional[List[Tuple[str, str, float]]] = None,
+            tolerance: float = DEFAULT_TOLERANCE,
+            window: int = DEFAULT_WINDOW,
+            direction: str = "auto") -> dict:
+    """Judge ``result`` against the trajectory and budgets.
+
+    Returns a JSON-able verdict with ``status`` one of ``ok`` /
+    ``regression`` / ``no-history`` and the evidence behind it; the CLI
+    maps status to the exit-code contract.
+    """
+    metric = result.get("metric")
+    value = float(result["value"])
+    comparable = [
+        (path, float(p["value"])) for path, p in history
+        if p.get("metric") == metric
+        and isinstance(p.get("value"), (int, float)) and p["value"] > 0]
+    verdict: dict = {"metric": metric, "value": value,
+                     "tolerance": tolerance,
+                     "history_total": len(history),
+                     "history_comparable": len(comparable),
+                     "violations": []}
+    dirn = resolve_direction(metric or "", direction)
+    verdict["direction"] = dirn
+    if comparable:
+        recent = [v for _, v in comparable[-int(window):]]
+        baseline = _lower_median(recent)
+        verdict["baseline"] = baseline
+        verdict["baseline_window"] = recent
+        if baseline > 0:
+            verdict["ratio"] = round(value / baseline, 6)
+        if dirn == "higher":
+            bound = baseline * (1.0 - tolerance)
+            if value < bound:
+                verdict["violations"].append(
+                    f"{metric}={value:g} regressed below trajectory "
+                    f"baseline {baseline:g} (tolerance {tolerance:.0%})")
+        else:
+            bound = baseline * (1.0 + tolerance)
+            if value > bound:
+                verdict["violations"].append(
+                    f"{metric}={value:g} regressed above trajectory "
+                    f"baseline {baseline:g} (tolerance {tolerance:.0%})")
+    for key, op, limit in (budgets or []):
+        got = _field(result, key)
+        if not isinstance(got, (int, float)):
+            verdict["violations"].append(
+                f"budget {key}{op}{limit:g}: result has no numeric "
+                f"{key!r} field")
+            continue
+        ok = got <= limit if op == "<=" else got >= limit
+        if not ok:
+            verdict["violations"].append(
+                f"budget {key}{op}{limit:g} violated: {key}={got:g}")
+    if verdict["violations"]:
+        verdict["status"] = "regression"
+    elif not comparable and not budgets:
+        verdict["status"] = "no-history"
+    else:
+        verdict["status"] = "ok"
+    return verdict
+
+
+def exit_code(verdict: dict) -> int:
+    return {"ok": EXIT_OK, "regression": EXIT_REGRESSION,
+            "no-history": EXIT_NO_HISTORY}[verdict["status"]]
+
+
+def guard(result_path: str, history_pattern: str = "",
+          budgets_path: str = "",
+          tolerance: float = DEFAULT_TOLERANCE,
+          window: int = DEFAULT_WINDOW,
+          direction: str = "auto") -> dict:
+    """One-call form used by bench.py: load everything, compare, and
+    fold any :class:`MalformedInput` into a ``status: "malformed"``
+    verdict instead of raising (bench must bank its result regardless)."""
+    try:
+        result = load_result(result_path)
+        history = load_history(history_pattern) if history_pattern else []
+        budgets = load_budgets(budgets_path) if budgets_path else None
+    except MalformedInput as e:
+        return {"status": "malformed", "error": str(e), "violations": []}
+    return compare(result, history, budgets=budgets, tolerance=tolerance,
+                   window=window, direction=direction)

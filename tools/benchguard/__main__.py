@@ -1,0 +1,70 @@
+"""CLI: ``python -m tools.benchguard result.json --history 'BENCH_r*.json'``.
+
+Exit status: 0 ok / 1 regression or budget violation / 2 no history to
+compare and no budgets / 3 malformed result or budgets JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (DEFAULT_TOLERANCE, DEFAULT_WINDOW, EXIT_MALFORMED,
+               MalformedInput, compare, exit_code, load_budgets,
+               load_history, load_result)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchguard",
+        description="compare a fresh bench result against the banked "
+                    "BENCH_r*.json trajectory and static budgets")
+    ap.add_argument("result", help="fresh result JSON (bench_result.json "
+                                   "shape, or a BENCH_r*.json wrapper)")
+    ap.add_argument("--history", default="BENCH_r*.json",
+                    help="glob of banked rounds (default: BENCH_r*.json)")
+    ap.add_argument("--budgets", default="",
+                    help="JSON object of static bounds, e.g. "
+                         '{"value": ">=0.5", "extras.mfu": ">=0.1"}')
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed fractional slip vs the trajectory "
+                         "baseline (default 0.10)")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="newest N comparable rounds forming the baseline")
+    ap.add_argument("--direction", choices=("auto", "higher", "lower"),
+                    default="auto", help="which way is better for the "
+                                         "metric (default: infer from name)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full verdict as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        result = load_result(args.result)
+        budgets = load_budgets(args.budgets) if args.budgets else None
+    except MalformedInput as e:
+        if args.as_json:
+            print(json.dumps({"status": "malformed", "error": str(e)}))
+        else:
+            print(f"benchguard: MALFORMED — {e}", file=sys.stderr)
+        return EXIT_MALFORMED
+    history = load_history(args.history)
+    verdict = compare(result, history, budgets=budgets,
+                      tolerance=args.tolerance, window=args.window,
+                      direction=args.direction)
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        status = verdict["status"].upper()
+        base = verdict.get("baseline")
+        base_txt = f" vs baseline {base:g}" if base is not None else \
+            " (no comparable history)"
+        print(f"benchguard: {status} — {verdict['metric']}="
+              f"{verdict['value']:g}{base_txt}")
+        for v in verdict["violations"]:
+            print(f"  violation: {v}", file=sys.stderr)
+    return exit_code(verdict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

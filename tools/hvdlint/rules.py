@@ -230,7 +230,7 @@ class FaultSitesRule:
 # contract says a disabled tracer/timeline/fault state costs one is-None
 # check, so nothing may allocate or read clocks before that check
 _GUARD_SUFFIXES = ("tracer", "timeline", "span", "auditor", "recorder",
-                   "watchdog")
+                   "watchdog", "ledger")
 _GUARD_NAMES = {"st", "state", "tl"}
 
 
