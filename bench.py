@@ -461,6 +461,22 @@ def main():
     extras["perf_exposed_comm_frac"] = pstats.get("exposed_comm_frac")
     extras["perf_negotiate_p95_ms"] = pstats.get("negotiate_p95_ms")
     extras["perf_step_wire_bytes"] = pstats.get("step_wire_bytes")
+    # Device-memory & compile accounting when HOROVOD_MEMLEDGER is on
+    # (docs/observability.md "Memory & compile ledger"). Same
+    # None-when-off convention: the driver's trend tooling must tell
+    # "ledger off" from "zero bytes compiled".
+    mrep = hvd.memory_report()
+    if mrep.get("enabled"):
+        _mc = mrep.get("compile", {})
+        extras["mem_peak_bytes"] = int(mrep.get("peak_bytes") or 0)
+        extras["compile_seconds_total"] = _mc.get("compile_seconds_total")
+        from horovod_tpu.ops import collectives as _C
+
+        extras["plan_cache_program_bytes"] = int(_C.plan_cache_bytes())
+    else:
+        extras["mem_peak_bytes"] = None
+        extras["compile_seconds_total"] = None
+        extras["plan_cache_program_bytes"] = None
     if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
         # honest metadata: this run is the forced-CPU fallback because the
         # TPU child failed/hung (wedged tunnel) — numbers are NOT chip

@@ -126,6 +126,20 @@ def perf_report() -> dict:
     return _perfledger.report()
 
 
+def memory_report() -> dict:
+    """This rank's device-memory & compile ledger (utils/memledger.py):
+    live/peak device bytes, per-component attribution (plan_cache /
+    staging_ring / ef_residuals / sharded_state), the dominant suspect
+    component, recent samples, and compile accounting (per-kind compile
+    seconds, serialized program bytes, persistent-cache hit/miss).
+    ``{"enabled": False}`` unless HOROVOD_MEMLEDGER was set at init.
+    The merged cross-rank view is ``GET /memory`` on the launcher's
+    rendezvous server (docs/observability.md)."""
+    from .utils import memledger as _memledger
+
+    return _memledger.report()
+
+
 def diagnose() -> dict:
     """The local diagnostic bundle (utils/diag.py): all-thread stacks,
     lockcheck state, a metrics snapshot, open tracing spans, the flight

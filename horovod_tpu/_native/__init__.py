@@ -271,6 +271,13 @@ class StagingRing:
             self._tokens[index] = token
             _staging_metrics()[3].set(self._inflight())
 
+    def allocated_bytes(self) -> int:
+        """Host bytes currently pinned by lazily-allocated slots (each
+        allocated slot holds ``capacity`` bytes regardless of lease
+        state) — the memledger's staging_ring component attribution."""
+        with self._lock:
+            return sum(int(b.nbytes) for b in self._bufs if b is not None)
+
     def resize(self, nbytes: int):
         """Adopt a new capacity (fusion threshold changed). Existing
         buffers are dropped — in-flight consumers hold their own
@@ -310,6 +317,10 @@ class FusionBuffer:
     def resize(self, nbytes: int):
         self.nbytes = nbytes
         self.ring.resize(nbytes)
+
+    def allocated_bytes(self) -> int:
+        """Staging-ring host bytes actually allocated (memledger pull)."""
+        return self.ring.allocated_bytes()
 
     def pack_leased(self, arrays):
         """Pack into a leased ring slot. Returns ``(flat, lease)`` where

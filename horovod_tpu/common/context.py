@@ -278,6 +278,13 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
 
         perfledger_mod.init_ledger(rank=_ctx.global_set.cross_rank)
 
+        # device-memory & compile ledger, same placement rationale: the
+        # plan-build instrumentation in ops/collectives.py checks the
+        # ledger handle at plan-cache-miss time
+        from ..utils import memledger as memledger_mod
+
+        memledger_mod.init_ledger(rank=_ctx.global_set.cross_rank)
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)
@@ -345,15 +352,23 @@ def _start_diag():
     the flight recorder (``HOROVOD_FLIGHTREC``), the wedge watchdog
     (``HOROVOD_WATCHDOG_SECS`` > 0), the signal/crash dump hooks, and —
     in a launched job — a dedicated KV client so watchdog/crash bundles
-    ride the push path into the launcher's ``GET /debug``. With both
-    knobs off, nothing is created and no hook is installed."""
+    ride the push path into the launcher's ``GET /debug``. The memory
+    ledger (``HOROVOD_MEMLEDGER``) arms the same path for its OOM
+    forensics. With all knobs off, nothing is created and no hook is
+    installed."""
     from ..utils import diag as diag_mod
     from ..utils import flightrec as flightrec_mod
+
+    from ..utils import memledger as memledger_mod
 
     recorder = flightrec_mod.init_recorder(rank=_ctx.global_set.cross_rank)
     flightrec_mod.note("init_phase", phase="config")
     wd = diag_mod.init_watchdog(_ctx.config.watchdog_secs)
-    if recorder is None and wd is None:
+    # the memory ledger is a third reason to arm the dump path: its OOM
+    # forensics contract is "an allocation failure yields a pushed oom
+    # bundle the launcher's GET /debug can attribute", with no flight
+    # recorder or watchdog required
+    if recorder is None and wd is None and not memledger_mod.enabled():
         return
     addr = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_ADDR)
     port = os.environ.get(env_schema.HOROVOD_GLOO_RENDEZVOUS_PORT)

@@ -118,6 +118,14 @@ HOROVOD_DIAG_DIR = "HOROVOD_DIAG_DIR"
 HOROVOD_PERFLEDGER = "HOROVOD_PERFLEDGER"
 HOROVOD_PERFLEDGER_BUFFER = "HOROVOD_PERFLEDGER_BUFFER"
 HOROVOD_SLO_SPEC = "HOROVOD_SLO_SPEC"
+# device-memory & compile ledger (utils/memledger.py;
+# docs/observability.md "Memory & compile ledger"): master switch and
+# sample-ring capacity, plus an optional byte cap on the compiled-plan
+# cache (ops/collectives.py) driving reason="memory" evictions from the
+# per-plan program-size accounting (0 = uncapped)
+HOROVOD_MEMLEDGER = "HOROVOD_MEMLEDGER"
+HOROVOD_MEMLEDGER_BUFFER = "HOROVOD_MEMLEDGER_BUFFER"
+HOROVOD_PLAN_CACHE_MAX_BYTES = "HOROVOD_PLAN_CACHE_MAX_BYTES"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -239,6 +247,12 @@ class RuntimeConfig:
     perfledger_enabled: bool = False
     perfledger_buffer: int = 1024
     slo_spec: str = ""
+    # device-memory & compile ledger (utils/memledger.py) — off by
+    # default (zero-cost contract: no hvd_mem_*/hvd_compile_* series);
+    # plan_cache_max_bytes=0 leaves the plan cache entry-capped only
+    memledger_enabled: bool = False
+    memledger_buffer: int = 512
+    plan_cache_max_bytes: int = 0
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -295,4 +309,9 @@ class RuntimeConfig:
         c.perfledger_buffer = get_int(HOROVOD_PERFLEDGER_BUFFER,
                                       c.perfledger_buffer)
         c.slo_spec = get_str(HOROVOD_SLO_SPEC)
+        c.memledger_enabled = get_bool(HOROVOD_MEMLEDGER)
+        c.memledger_buffer = get_int(HOROVOD_MEMLEDGER_BUFFER,
+                                     c.memledger_buffer)
+        c.plan_cache_max_bytes = get_int(HOROVOD_PLAN_CACHE_MAX_BYTES,
+                                         c.plan_cache_max_bytes)
         return c

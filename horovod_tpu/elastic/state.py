@@ -122,6 +122,12 @@ class _HostUpdateListener:
 
                 flightrec.note("elastic_generation", epoch=cur,
                                previous=self._seen_epoch)
+                # a resize drops plans/residuals and rebuilds sharded
+                # layouts — stamp a memory sample at the boundary so
+                # before/after attribution survives in the ring
+                from ..utils import memledger
+
+                memledger.sample_event("elastic_resize")
                 self._seen_epoch = cur
                 self.change_count += 1
             self._stop.wait(self.WATCH_INTERVAL_S)

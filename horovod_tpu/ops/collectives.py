@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from enum import IntEnum
 import logging
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -213,8 +214,9 @@ _plan_metric_handles = None
 
 
 def _plan_metrics():
-    """(hits, misses, lru_evictions, invalidations, cache_size_gauge) —
-    resolved once; the cycle loop touches only prebuilt handles."""
+    """(hits, misses, lru_evictions, invalidations, cache_size_gauge,
+    memory_evictions) — resolved once; the cycle loop touches only
+    prebuilt handles."""
     global _plan_metric_handles
     if _plan_metric_handles is None:
         from ..utils import metrics as metrics_mod
@@ -231,6 +233,8 @@ def _plan_metrics():
                         "fused-chunk plans evicted", reason="invalidation"),
             reg.gauge("hvd_fused_plan_cache_size",
                       "fused-chunk plans currently cached"),
+            reg.counter("hvd_fused_plan_evictions_total",
+                        "fused-chunk plans evicted", reason="memory"),
         )
     return _plan_metric_handles
 
@@ -251,11 +255,127 @@ def _cache_capacity() -> int:
         return 1024
 
 
+# Per-plan program-size accounting, fed by the memledger's first-call
+# compile instrumentation (utils/memledger.instrument_plan reports each
+# compiled program's serialized size through _note_plan_bytes). Armed
+# together with that instrumentation — HOROVOD_MEMLEDGER on or
+# HOROVOD_PLAN_CACHE_MAX_BYTES set — so the default state keeps these
+# dicts empty and the hit path pays one dict get for the diag table.
+_PLAN_BYTES: dict = {}
+_PLAN_META: dict = {}
+_plan_bytes_total = 0
+_plan_bytes_gauge = None
+
+
+def plan_cache_bytes() -> int:
+    """Total measured serialized-program bytes held by the eager cache —
+    the memledger's ``plan_cache`` attribution. Zero until the size
+    accounting is armed and plans have actually compiled."""
+    return _plan_bytes_total
+
+
+def _plan_kind(key) -> str:
+    """Plan-kind label for compile accounting and the diag table,
+    derived from the cache-key layout (eager programs have free-form
+    keys; plan keys lead with _PLAN_KEY and a stage tag)."""
+    if not (isinstance(key, tuple) and key and key[0] == _PLAN_KEY):
+        return "eager"
+    sub = key[1] if len(key) > 1 else ""
+    if isinstance(sub, str) and sub.startswith("sharded_"):
+        return sub
+    if len(key) > 2 and key[2] == "quant_sim":
+        return "quant"
+    # plain fused allreduce keys have 13 elements; the quantized flavor
+    # appends the quantization signature as a 14th
+    return "quant" if len(key) > 13 else "fused"
+
+
+def _meta_track(key, kind: Optional[str] = None) -> None:
+    """Record plan-cache metadata at a miss (cold path) so the diag
+    bundle's plan-cache table can show kind/age/hits."""
+    _PLAN_META[key] = {"kind": kind or _plan_kind(key),
+                       "created_mono": time.monotonic(), "hits": 0}
+
+
+def _forget_plan_bytes(key) -> None:
+    _PLAN_META.pop(key, None)
+    nbytes = _PLAN_BYTES.pop(key, None)
+    if nbytes:
+        global _plan_bytes_total
+        _plan_bytes_total = max(_plan_bytes_total - nbytes, 0)
+        if _plan_bytes_gauge is not None:
+            _plan_bytes_gauge.set(_plan_bytes_total)
+
+
+def _note_plan_bytes(key, nbytes: int) -> None:
+    """Size callback the compile instrumentation fires once per compiled
+    program (a plan may own several — pack/quantize/run): accumulate
+    per-key bytes, refresh the gauge, then apply the byte cap."""
+    global _plan_bytes_total, _plan_bytes_gauge
+    if key not in _EAGER_CACHE:
+        return  # evicted before its first call finished compiling
+    _PLAN_BYTES[key] = _PLAN_BYTES.get(key, 0) + int(nbytes)
+    _plan_bytes_total += int(nbytes)
+    meta = _PLAN_META.get(key)
+    if meta is not None:
+        meta["program_bytes"] = _PLAN_BYTES[key]
+    if _plan_bytes_gauge is None:
+        from ..utils import metrics as metrics_mod
+
+        _plan_bytes_gauge = metrics_mod.get_registry().gauge(
+            "hvd_fused_plan_program_bytes",
+            "measured serialized-program bytes held by the eager plan "
+            "cache")
+    _plan_bytes_gauge.set(_plan_bytes_total)
+    _evict_over_bytes()
+
+
+def _evict_over_bytes():
+    """``HOROVOD_PLAN_CACHE_MAX_BYTES`` memory-pressure eviction: drop
+    the oldest entries until the measured program bytes fit the cap.
+    The newest entry always survives (evicting the plan that just
+    compiled would thrash); entries whose programs have not compiled yet
+    count zero bytes, matching what the accounting has actually seen."""
+    global _plan_count
+    cap = env_schema.get_int(env_schema.HOROVOD_PLAN_CACHE_MAX_BYTES, 0)
+    if cap <= 0:
+        return
+    while _plan_bytes_total > cap and len(_EAGER_CACHE) > 1:
+        k, _ = _EAGER_CACHE.popitem(last=False)
+        _forget_plan_bytes(k)
+        if k and k[0] == _PLAN_KEY:
+            _plan_count -= 1
+            m = _plan_metrics()
+            m[5].inc()
+            m[4].set(_plan_count)
+
+
+def plan_cache_table(limit: int = 50) -> list:
+    """What the plan cache holds — the diag-bundle table (kind, age,
+    hit count, measured program bytes). Metadata exists for entries
+    inserted while the size accounting was armed; older entries still
+    show their kind. Newest ``limit`` entries, newest first."""
+    now = time.monotonic()
+    rows = []
+    for key in list(_EAGER_CACHE)[-int(limit):]:
+        meta = _PLAN_META.get(key)
+        rows.append({
+            "kind": meta["kind"] if meta else _plan_kind(key),
+            "age_s": (round(now - meta["created_mono"], 3)
+                      if meta else None),
+            "hits": meta["hits"] if meta else None,
+            "program_bytes": _PLAN_BYTES.get(key),
+        })
+    rows.reverse()
+    return rows
+
+
 def _evict_over_capacity():
     global _plan_count
     cap = _cache_capacity()
     while cap > 0 and len(_EAGER_CACHE) > cap:
         k, _ = _EAGER_CACHE.popitem(last=False)
+        _forget_plan_bytes(k)
         if k and k[0] == _PLAN_KEY:
             _plan_count -= 1
             m = _plan_metrics()
@@ -267,19 +387,33 @@ def _cached(key, builder):
     fn = _EAGER_CACHE.get(key)
     if fn is None:
         fn = builder()
+        from ..utils import memledger as memledger_mod
+
+        if memledger_mod.accounting_armed():
+            fn = memledger_mod.instrument_plan(
+                fn, "eager", lambda n, k=key: _note_plan_bytes(k, n))
+            _meta_track(key, "eager")
         _EAGER_CACHE[key] = fn
         _evict_over_capacity()
     else:
         _EAGER_CACHE.move_to_end(key)
+        meta = _PLAN_META.get(key)
+        if meta is not None:
+            meta["hits"] += 1
     return fn
 
 
 def clear_eager_cache():
-    global _plan_count
+    global _plan_count, _plan_bytes_total
     _EAGER_CACHE.clear()
+    _PLAN_BYTES.clear()
+    _PLAN_META.clear()
+    _plan_bytes_total = 0
     _plan_count = 0
     if _plan_metric_handles is not None:
         _plan_metric_handles[4].set(0)
+    if _plan_bytes_gauge is not None:
+        _plan_bytes_gauge.set(0)
 
 
 def invalidate_fused_plans() -> int:
@@ -292,6 +426,7 @@ def invalidate_fused_plans() -> int:
     stale = [k for k in _EAGER_CACHE if k and k[0] == _PLAN_KEY]
     for k in stale:
         del _EAGER_CACHE[k]
+        _forget_plan_bytes(k)
     if stale:
         _plan_count = 0
         m = _plan_metrics()
@@ -622,9 +757,18 @@ def _insert_plan(key, builder):
     if plan is not None:
         _EAGER_CACHE.move_to_end(key)
         m[0].inc()
+        meta = _PLAN_META.get(key)
+        if meta is not None:
+            meta["hits"] += 1
         return plan
     m[1].inc()
     plan = builder()
+    from ..utils import memledger as memledger_mod
+
+    if memledger_mod.accounting_armed():
+        plan = memledger_mod.instrument_plan(
+            plan, _plan_kind(key), lambda n, k=key: _note_plan_bytes(k, n))
+        _meta_track(key)
     global _plan_count
     _EAGER_CACHE[key] = plan
     _plan_count += 1
@@ -930,9 +1074,18 @@ def _sharded_plan(key, builder):
     if plan is not None:
         _EAGER_CACHE.move_to_end(key)
         m[0].inc()
+        meta = _PLAN_META.get(key)
+        if meta is not None:
+            meta["hits"] += 1
         return plan
     m[1].inc()
     plan = builder()
+    from ..utils import memledger as memledger_mod
+
+    if memledger_mod.accounting_armed():
+        plan = memledger_mod.instrument_plan(
+            plan, _plan_kind(key), lambda n, k=key: _note_plan_bytes(k, n))
+        _meta_track(key)
     _EAGER_CACHE[key] = plan
     _plan_count += 1
     _evict_over_capacity()

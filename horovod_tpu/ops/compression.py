@@ -413,6 +413,11 @@ class ResidualStore:
     def __len__(self) -> int:
         return len(self._res)
 
+    def nbytes(self) -> int:
+        """Bytes held by live residuals (memledger ef_residuals pull;
+        best-effort — racing the cycle thread only skews a sample)."""
+        return sum(int(getattr(r, "nbytes", 0)) for r in self._res.values())
+
 
 # --- API-surface quantized compressor markers ------------------------------
 

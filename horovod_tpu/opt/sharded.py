@@ -66,6 +66,7 @@ from ..ops import collectives as C
 from ..ops.collectives import ReduceOp
 from ..parallel.sharding_policy import DEFAULT_MIN_SHARD_ELEMS, should_shard
 from ..utils import flightrec
+from ..utils import memledger as memledger_mod
 
 _SUPPORTED_OPS = (ReduceOp.AVERAGE, ReduceOp.SUM)
 
@@ -443,6 +444,7 @@ class ShardedUpdateEngine:
                        groups=len(layout.groups),
                        replicated_leaves=len(layout.replicated),
                        shard_elems=layout.shard_elems)
+        memledger_mod.sample_event("sharded_layout_rebuild")
         return layout
 
     # -- state --------------------------------------------------------------
@@ -457,7 +459,12 @@ class ShardedUpdateEngine:
             "rep": {_rep_key(i): leaves[i] for i in layout.replicated},
             "shard": self._param_shards(layout, leaves),
         }
-        return self._opt.init(combined)
+        state = self._opt.init(combined)
+        # the sharded-state bytes are the whole point of ZeRO-1: the
+        # ledger's component attribution turns "should be 1/N" into a
+        # measured number (tests/test_sharded_update.py asserts it)
+        memledger_mod.note_sharded_state(state)
+        return state
 
     # -- phase methods (shared by step() and simulated_step()) --------------
 

@@ -131,6 +131,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_debug()
         if key == "perf":
             return self._do_perf()
+        if key == "memory":
+            return self._do_memory()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -329,6 +331,48 @@ class _KVHandler(BaseHTTPRequestHandler):
             snap["stale"] = rank in stale
             ranks[rank] = snap
         local = perfledger_mod.get_ledger()
+        if local is not None and str(local.rank) not in ranks:
+            snap = local.snapshot()
+            snap["stale"] = False
+            ranks[str(local.rank)] = snap
+        body = json.dumps({"ranks": ranks}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_memory(self):
+        """``GET /memory``: merge every device-memory-ledger snapshot
+        ranks pushed under the ``mem/`` KV scope (utils/memledger.py)
+        into one JSON view — per rank: live/peak bytes, per-component
+        attribution, the newest raw samples, compile accounting, and a
+        ``stale`` flag when that rank's push stamp lags the newest push
+        (same annotate-don't-drop policy as ``/metrics``). Auth-exempt
+        read-only telemetry, same rationale as ``/metrics``."""
+        import json
+
+        from ..utils import memledger as memledger_mod
+
+        store = self.server.store  # type: ignore[attr-defined]
+        scope_prefix = memledger_mod.KV_SCOPE + "/"
+        with store.cond:
+            pushed = {k: v for k, v in store.data.items()
+                      if k.startswith(scope_prefix)}
+        entries = []
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank1"
+            rank = suffix[4:] if suffix.startswith("rank") else suffix
+            try:
+                entries.append((rank, json.loads(v)))
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+        stale = _stale_ranks(entries)
+        ranks = {}
+        for rank, snap in entries:
+            snap["stale"] = rank in stale
+            ranks[rank] = snap
+        local = memledger_mod.get_ledger()
         if local is not None and str(local.rank) not in ranks:
             snap = local.snapshot()
             snap["stale"] = False

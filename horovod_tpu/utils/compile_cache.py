@@ -6,20 +6,46 @@ compiled program a one-time cost for the machine rather than per
 process. (The reference has no analogue — CUDA kernels ship prebuilt;
 for XLA the compile IS the build step, so cache management belongs in
 the framework.)
+
+The enabled cache directory is recorded (:func:`active_cache_dir`) so
+the memledger's compile instrumentation (utils/memledger.py) can infer
+persistent-cache hit/miss from the cache-dir entry delta across a
+compile, and a failure to enable is visible three ways instead of being
+a mystery recompile per process: a one-time warning with the reason,
+the reason as the return value, and an ``hvd_compile_cache_enabled``
+gauge (1/0).
 """
 
+import logging
 import os
 from typing import Optional
 
 from ..common import env as env_schema
 
+LOG = logging.getLogger("horovod_tpu")
+
+_ACTIVE_DIR: Optional[str] = None
+_WARNED = False
+
+
+def active_cache_dir() -> Optional[str]:
+    """The persistent-cache directory enabled in this process, or None —
+    the memledger's hit/miss inference keys off this."""
+    return _ACTIVE_DIR
+
 
 def enable_compilation_cache(cache_dir: Optional[str] = None,
-                             min_compile_time_secs: float = 1.0) -> bool:
+                             min_compile_time_secs: float = 1.0,
+                             ) -> Optional[str]:
     """Point JAX's persistent compilation cache at ``cache_dir``
     (default: ``$HOROVOD_COMPILE_CACHE`` or ``~/.cache/horovod_tpu_xla``).
-    Returns True if enabled. Never raises: the cache is an optimization.
+
+    Returns None on success, else the failure reason (also warned once
+    per process and published on the ``hvd_compile_cache_enabled``
+    gauge). Never raises: the cache is an optimization — but a
+    mis-pointed ``HOROVOD_COMPILE_CACHE`` must be visible, not silent.
     """
+    global _ACTIVE_DIR, _WARNED
     import jax
 
     try:
@@ -32,6 +58,20 @@ def enable_compilation_cache(cache_dir: Optional[str] = None,
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_time_secs))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        return True
-    except Exception:
-        return False
+        reason = None
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+    from . import metrics as metrics_mod
+
+    metrics_mod.get_registry().gauge(
+        "hvd_compile_cache_enabled",
+        "1 when the persistent XLA compile cache is enabled, 0 when the "
+        "last enable attempt failed").set(0 if reason else 1)
+    if reason is None:
+        _ACTIVE_DIR = cache_dir
+        return None
+    if not _WARNED:
+        _WARNED = True
+        LOG.warning("persistent compilation cache NOT enabled (%s): every "
+                    "process pays every compile", reason)
+    return reason

@@ -102,6 +102,38 @@ def thread_stacks() -> List[dict]:
     return out
 
 
+# RESOURCE_EXHAUSTED-shaped exception markers. XLA surfaces a device OOM
+# as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."); host allocators say
+# "out of memory"; Python itself raises MemoryError. Matched on the
+# rendered exception so wrapper exception types don't hide the verdict.
+_ALLOC_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                  "out of memory", "Out of memory", "OutOfMemory",
+                  "failed to allocate", "Allocation failure")
+
+
+def is_alloc_failure(exc) -> bool:
+    """OOM/alloc-failure classifier for the dump-first excepthook path:
+    allocation-shaped exceptions get an ``oom`` bundle whose memory
+    section names a suspect component instead of a bare dead rank."""
+    if isinstance(exc, MemoryError):
+        return True
+    try:
+        text = f"{type(exc).__name__}: {exc}"
+    except Exception:
+        return False
+    return any(marker in text for marker in _ALLOC_MARKERS)
+
+
+def maybe_dump_alloc_failure(exc) -> str:
+    """Classify + dump in one call, for code that catches its own
+    exceptions (training loops, framework shims): writes an ``oom``
+    bundle iff ``exc`` is allocation-shaped. Returns the bundle path
+    ("" when not an alloc failure or the write failed)."""
+    if not is_alloc_failure(exc):
+        return ""
+    return dump_bundle("oom")
+
+
 def build_bundle(reason: str, last_events: int = 200,
                  stall: Optional[dict] = None) -> dict:
     """The local diagnostic bundle (``hvd.diagnose()`` returns this)."""
@@ -141,6 +173,22 @@ def build_bundle(reason: str, last_events: int = 200,
         except Exception as e:
             probes[name] = {"error": repr(e)}
     bundle["probes"] = probes
+    # OOM forensics: the memory ledger's view (recent samples, component
+    # attribution, top live buffers, suspect; {"enabled": False} plus the
+    # buffer table when the ledger is off) and what the plan cache held —
+    # a wedge dump used to show stacks but not the cache contents
+    try:
+        from . import memledger as memledger_mod
+
+        bundle["memory"] = memledger_mod.forensics()
+    except Exception as e:
+        bundle["memory"] = {"error": repr(e)}
+    try:
+        from ..ops import collectives as collectives_mod
+
+        bundle["plan_cache"] = collectives_mod.plan_cache_table()
+    except Exception as e:
+        bundle["plan_cache"] = [{"error": repr(e)}]
     return bundle
 
 
@@ -368,7 +416,7 @@ def install_crash_hooks() -> None:
 
     def _excepthook(etype, value, tb):
         try:
-            dump_bundle("crash")
+            dump_bundle("oom" if is_alloc_failure(value) else "crash")
         except Exception:
             pass
         prev_hook(etype, value, tb)
@@ -398,13 +446,17 @@ def reset_crash_hooks_for_tests() -> None:
 def merge_bundles(bundles: Dict[int, dict]) -> dict:
     """Merge per-rank bundles into one attribution view.
 
-    Suspect naming, strongest signal first: (1) the union of
-    ``missing_ranks`` from any coordinator gather probe — the ranks the
-    coordinator was still waiting on are the wedge by definition;
-    (2) otherwise the rank with the largest watchdog stall age.
+    Suspect naming, strongest signal first: (1) any rank whose bundle
+    reason is ``oom`` — the rank that hit the allocation failure is the
+    suspect by definition, attributed to its memory section's dominant
+    component; (2) the union of ``missing_ranks`` from any coordinator
+    gather probe — the ranks the coordinator was still waiting on are
+    the wedge by definition; (3) otherwise the rank with the largest
+    watchdog stall age.
     """
     ranks: Dict[str, dict] = {}
     missing: set = set()
+    oom_ranks = []
     worst_age, worst_rank = -1.0, None
     for rank, b in sorted(bundles.items()):
         if not isinstance(b, dict):
@@ -412,6 +464,7 @@ def merge_bundles(bundles: Dict[int, dict]) -> dict:
         stall = b.get("stall") or {}
         probes = b.get("probes") or {}
         coord = probes.get("coordinator") or {}
+        mem = b.get("memory") or {}
         info = {
             "reason": b.get("reason"),
             "hostname": b.get("hostname"),
@@ -421,8 +474,12 @@ def merge_bundles(bundles: Dict[int, dict]) -> dict:
             "flight_events": len(b.get("flight_events") or ()),
             "open_spans": (b.get("trace") or {}).get("open_spans"),
             "coordinator": coord or None,
+            "memory_suspect": mem.get("suspect"),
+            "peak_bytes": mem.get("peak_bytes"),
         }
         ranks[str(rank)] = info
+        if b.get("reason") == "oom":
+            oom_ranks.append((rank, mem.get("suspect")))
         for m in coord.get("missing_ranks") or ():
             try:
                 missing.add(int(m))
@@ -434,6 +491,13 @@ def merge_bundles(bundles: Dict[int, dict]) -> dict:
             age = -1.0
         if age > worst_age:
             worst_age, worst_rank = age, rank
+    if oom_ranks:
+        component = next((c for _, c in oom_ranks if c), None)
+        attribution = "allocation failure (oom bundle)"
+        if component:
+            attribution += f": dominant component {component}"
+        return {"ranks": ranks, "suspects": [r for r, _ in oom_ranks],
+                "attribution": attribution}
     if missing:
         return {"ranks": ranks, "suspects": sorted(missing),
                 "attribution": "coordinator gather: ranks never submitted"}

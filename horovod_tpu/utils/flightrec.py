@@ -50,6 +50,7 @@ CATEGORIES = (
     ("diag_dump", "diagnostic bundle written"),
     ("quant_fallback", "tensor kept off the quantized wire"),
     ("slo_breach", "declared SLO budget crossed its bound"),
+    ("compile", "XLA program compiled for a cached plan"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

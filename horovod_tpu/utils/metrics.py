@@ -445,6 +445,26 @@ class MetricsDumper:
                         json.dumps(psnap).encode())
         except Exception as e:
             LOG.debug("perf KV push failed: %s", e)
+        # memory-ledger sampling + push ride the same cadence: the flush
+        # interval IS the interval-sample cadence, and the pushed
+        # snapshots feed the launcher's GET /memory merge. Outside the
+        # kv_client gate so file-only (and test) dumpers still sample.
+        try:
+            from . import memledger as memledger_mod
+
+            mledger = memledger_mod.get_ledger()
+            if mledger is not None:
+                mledger.sample(event="interval")
+                if self.kv_client is not None:
+                    msnap = mledger.snapshot()
+                    msnap["push_seq"] = self._push_seq
+                    msnap["push_ts"] = time.time()
+                    msnap["push_interval_s"] = self.interval_s
+                    self.kv_client.put(
+                        memledger_mod.KV_SCOPE, f"rank{self.rank}",
+                        json.dumps(msnap).encode())
+        except Exception as e:
+            LOG.debug("memory KV push failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

@@ -101,6 +101,10 @@ class PerfLedger:
         self._lock = lockcheck.make_lock("perfledger.ring")
         self._ring = collections.deque(maxlen=self.capacity)  # guarded-by: _lock
         self._total = 0  # guarded-by: _lock
+        # compile seconds handed over by the memledger (utils/memledger
+        # record_compile) since the last recorded step — a recompile
+        # storm must show up as host overhead, not silent exec time
+        self._compile_pending = 0.0  # guarded-by: _lock
         # counter baselines for per-step deltas; cycle-thread-only
         self._last_counters: dict = {}
         # running sums behind the goodput gauges (process lifetime, not
@@ -137,6 +141,13 @@ class PerfLedger:
         self._m_hit = reg.gauge(
             "hvd_perf_plan_hit_rate",
             "cumulative fused-plan cache hit rate seen by the ledger")
+
+    def note_compile(self, seconds: float) -> None:
+        """Attribute one XLA compile's wall time to the next recorded
+        step (called by the memledger's compile instrumentation; rare by
+        construction — once per plan program)."""
+        with self._lock:
+            self._compile_pending += max(float(seconds), 0.0)
 
     def _counter_deltas(self) -> dict:
         from . import metrics as metrics_mod
@@ -187,8 +198,19 @@ class PerfLedger:
             "stall": stall_s,
             "host_overhead": max(wall_s - negotiate_s - dispatch_s, 0.0),
         }
+        with self._lock:
+            compile_s = self._compile_pending
+            self._compile_pending = 0.0
+        if compile_s > 0.0:
+            # compile stalls happen inside the dispatch window; move the
+            # compiled slice out of device_exec into host_overhead so a
+            # recompile storm reads as host overhead, not device work
+            shift = min(compile_s, phases["device_exec"])
+            phases["device_exec"] -= shift
+            phases["host_overhead"] += shift
         rec = {"ts": time.time(), "tensors": int(tensors),
                "wall_s": wall_s,
+               "compile_s": round(compile_s, 6),
                "straggler_rank": strag_rank,
                "straggler_wait_s": round(strag_wait, 6)}
         for p in PHASES:
@@ -262,7 +284,12 @@ class PerfLedger:
         sum_wire = sum(r["wire_bytes"] for r in recs)
         hits = sum(r["plan_hits"] for r in recs)
         misses = sum(r["plan_misses"] for r in recs)
+        compiles = sorted(r.get("compile_s", 0.0) for r in recs)
         out.update({
+            # compile attribution (utils/memledger.py): SLO budgets like
+            # compile_seconds_p95<=0.5 bind here to bound recompile storms
+            "compile_seconds_total": sum(compiles),
+            "compile_seconds_p95": _percentile(compiles, 0.95),
             "step_p50_ms": _percentile(walls, 0.50) * 1e3,
             "step_p95_ms": _percentile(walls, 0.95) * 1e3,
             "negotiate_p50_ms": _percentile(rounds, 0.50) * 1e3,

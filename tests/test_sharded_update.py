@@ -234,6 +234,37 @@ def test_state_footprint_is_sharded():
     assert shard_bytes < 0.62 * rep_bytes   # ~0.5 + padding + replicated
 
 
+def test_memledger_measures_sharded_state_attribution(monkeypatch):
+    """The memory ledger turns the ZeRO-1 claim into a measured number:
+    with HOROVOD_MEMLEDGER on, ``engine.init`` pushes the built state's
+    bytes into the ``sharded_state`` component, and that measured value
+    must land at ~1/N of the replicated optimizer state."""
+    from horovod_tpu.utils import memledger as memledger_mod
+
+    monkeypatch.setenv(env_schema.HOROVOD_MEMLEDGER, "1")
+    # hermetic: a live session runtime from an earlier test must not pull
+    # its staging-ring bytes over the suspect this test asserts on
+    monkeypatch.setattr(memledger_mod.MemLedger, "_pull_components",
+                        lambda self: {})
+    memledger_mod.reset_ledger()
+    ledger = memledger_mod.init_ledger(rank=0)
+    try:
+        opt = optax.adam(1e-3)
+        params = _params()
+        engines = sharded_mod.make_simulated_engines(opt, 2)
+        [e.init(params) for e in engines]
+        rep_bytes = _tree_bytes(opt.init(params))
+        measured = ledger.components()["sharded_state"]
+        # note_sharded_state records the LAST engine built (one engine
+        # per process in a real world); each simulated rank holds the
+        # same ~1/2 + replicated remainder
+        assert 0.3 * rep_bytes < measured < 0.62 * rep_bytes, (
+            f"measured sharded_state={measured} vs replicated={rep_bytes}")
+        assert ledger.report()["suspect"] == "sharded_state"
+    finally:
+        memledger_mod.reset_ledger()
+
+
 def test_plan_hit_rate_steady_state():
     opt = optax.adam(1e-3)
     params = _params()
