@@ -461,6 +461,23 @@ def main():
     extras["perf_exposed_comm_frac"] = pstats.get("exposed_comm_frac")
     extras["perf_negotiate_p95_ms"] = pstats.get("negotiate_p95_ms")
     extras["perf_step_wire_bytes"] = pstats.get("step_wire_bytes")
+    # Control-plane scale-out telemetry (docs/scaling.md). Single-process
+    # benches have no rendezvous controller at all — every field is None
+    # then, and negotiation_format is None/"v1" whenever the hierarchy
+    # flag is off (the zero-new-series contract's bench-side mirror).
+    from horovod_tpu.common import context as _context_mod
+
+    _ctl = getattr(getattr(_context_mod.get_context(), "runtime", None),
+                   "controller", None)
+    extras["negotiation_format"] = (
+        _ctl.wire_format if _ctl is not None else None)
+    _ctl_rounds = _reg.counter_value("hvd_negotiation_rounds_total")
+    _ctl_wire = _reg.counter_value("hvd_controller_wire_bytes_total")
+    extras["controller_wire_bytes_per_round"] = (
+        round(_ctl_wire / _ctl_rounds, 1)
+        if _ctl is not None and _ctl_rounds else None)
+    extras["controller_round_p95_ms"] = pstats.get("negotiate_p95_ms") \
+        if _ctl is not None else None
     # Device-memory & compile accounting when HOROVOD_MEMLEDGER is on
     # (docs/observability.md "Memory & compile ledger"). Same
     # None-when-off convention: the driver's trend tooling must tell

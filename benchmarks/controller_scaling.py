@@ -12,15 +12,22 @@ bytes/round. Output: a markdown table + one JSON line per np.
 
 Budgeted mode (ROADMAP item 3's scaling gate, wired as a slow tier-1
 test in tests/test_perfledger.py): ``--budget`` simulates a pod-scale
-world — N (default 64) KVController instances on N in-process threads
-against one real HTTP store, the same wire protocol with thread-level
-instead of process-level concurrency — and asserts the negotiation-round
-p95 against a static bound through tools.benchguard's compare engine
-(exit 1 on breach, same contract as ``python -m tools.benchguard``).
+world — N KVController instances on N in-process threads against one
+real store, the same wire protocol with thread-level instead of
+process-level concurrency — TWICE per rank count: the legacy flat/JSON
+path and the HOROVOD_HIER_NEGOTIATION hierarchy+binary-wire+sharded-KV
+path. Budgets (benchmarks/controller_budgets.json) are asserted through
+tools.benchguard's compare engine (exit 1 on breach): an absolute p95
+bound on the flat path (a regression toward O(size) polling trips it)
+plus the scale-out acceptance ratios — hierarchical p95 <= 0.5x flat
+(``extras.hier_speedup >= 2``) and wire bytes per rank-round reduced
+>= 3x (``extras.bytes_reduction >= 3``).
 
 Usage: python benchmarks/controller_scaling.py [rounds]
-       python benchmarks/controller_scaling.py --budget [--ranks 64]
-           [--rounds 30] [--p95-ms 500] [--json]
+       python benchmarks/controller_scaling.py --budget [--ranks 256]
+           [--rounds 15] [--json]
+       python benchmarks/controller_scaling.py --sweep
+           (64/256/1024-rank budget legs, one JSON line each)
 """
 
 import json
@@ -31,6 +38,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "controller_budgets.json")
+
+#: Tensors negotiated per simulated round: a training step negotiates a
+#: batch of gradients, not one name — and batching is exactly where the
+#: interned binary wire and the leader's bitmap dedup pay off.
+TENSORS_PER_ROUND = 8
 
 
 def _worker(rank: int, nproc: int, port: int, rounds: int, q):
@@ -89,43 +104,88 @@ def measure(nproc: int, rounds: int) -> dict:
     return res
 
 
-def simulate(nranks: int, rounds: int,
-             timeout_s: float = 240.0) -> dict:
+def _raise_nofile(need: int):
+    """A 1024-rank simulation holds a few thousand sockets in one
+    process; lift the soft RLIMIT_NOFILE toward the hard cap."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(hard, max(soft, need))
+        if want > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except Exception:
+        pass  # best effort (non-POSIX or locked down)
+
+
+def simulate(nranks: int, rounds: int, timeout_s: float = 240.0,
+             hier: bool = False, group_size: int = 8,
+             shards: int = 1) -> dict:
     """Pod-scale negotiation simulation in one process.
 
     ``nranks`` KVController instances on ``nranks`` threads share one
     real RendezvousServer — the full wire protocol (puts, long-poll
-    GETs, SAME_AS_LAST fast path, coordinator thread on rank 0) with
+    reads, SAME_AS_LAST fast path, coordinator thread on rank 0) with
     thread-level instead of process-level workers, which is what lets a
-    1-CPU CI host exercise a 64-rank round. Negotiation is IO-bound
-    (HTTP long-polls release the GIL), so the protocol cost still
-    dominates the number. Returns rank 0's per-round latency stats.
+    1-CPU CI host exercise a pod-size round. Negotiation is IO-bound
+    (blocking reads release the GIL), so the protocol cost still
+    dominates the number. ``hier=True`` runs the scale-out path:
+    hierarchical leaders, binary wire v2, and a KV sharded ``shards``
+    ways. Each round negotiates TENSORS_PER_ROUND fresh names. Returns
+    rank 0's per-round latency stats plus whole-world wire-byte totals.
     """
+    import sys
     import threading
 
+    from horovod_tpu.common import env as env_schema
     from horovod_tpu.ops.controller import KVController
     from horovod_tpu.runner.http_server import (KVStoreClient,
                                                 RendezvousServer)
 
-    srv = RendezvousServer()
+    _raise_nofile(8 * nranks + 1024)
+    # Hundreds of threads stand in for independent hosts; the default
+    # 5 ms GIL switch interval adds multi-ms scheduling tail to every
+    # protocol hop that a real (process-per-host) deployment never
+    # pays. Tighten it for the measurement, identically for both legs.
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    # The lock-order auditor (armed by the test suite's conftest) wraps
+    # every acquisition in Python bookkeeping — a debug tool, not part
+    # of the protocol cost this harness measures. Locks consult the env
+    # at creation, so clearing it here un-audits exactly the objects
+    # built below, identically for both legs; the functional hier tests
+    # still run fully audited.
+    prev_lockcheck = os.environ.pop("HOROVOD_LOCKCHECK", None)
+    shards = max(1, int(shards)) if hier else 1
+    prev_shards = os.environ.get(env_schema.HOROVOD_KV_SHARDS)
+    if shards > 1:
+        os.environ[env_schema.HOROVOD_KV_SHARDS] = str(shards)
+    else:
+        os.environ.pop(env_schema.HOROVOD_KV_SHARDS, None)
+    srv = RendezvousServer(shards=shards)
     port = srv.start()
     sig = ["allreduce", "float32", [1024], 0, -1, 1.0, 1.0, "global",
            "host"]
     lat_s: list = []   # rank 0's per-round negotiate wall seconds
     errs: list = []
+    ctls: list = [None] * nranks
 
     def run(rank: int):
         ctl = None
         try:
             ctl = KVController(KVStoreClient("127.0.0.1", port), rank,
-                               nranks, poll_timeout=timeout_s)
-            ctl.negotiate({"warm": sig})  # scope setup / thread spin-up
+                               nranks, poll_timeout=timeout_s,
+                               hier=hier, hier_group_size=group_size)
+            ctls[rank] = ctl
+            ctl.negotiate({"warm": sig})  # scope setup / wv handshake
             for i in range(rounds):
+                pending = {f"t{i}_{j}": sig
+                           for j in range(TENSORS_PER_ROUND)}
                 t0 = time.perf_counter()
-                resp = ctl.negotiate({f"t{i}": sig})
+                resp = ctl.negotiate(pending)
                 if rank == 0:
                     lat_s.append(time.perf_counter() - t0)
-                assert resp["ready"] == [f"t{i}"], resp
+                assert set(resp["ready"]) == set(pending), resp
         except Exception as e:  # surfaced after join — a wedged rank
             errs.append((rank, repr(e)))  # must fail the run, not hang it
         finally:
@@ -146,70 +206,151 @@ def simulate(nranks: int, rounds: int,
         t.join(timeout=max(0.5, deadline - time.monotonic()))
     hung = [t.name for t in threads if t.is_alive()]
     srv.stop()
+    sys.setswitchinterval(prev_switch)
+    if prev_lockcheck is not None:
+        os.environ["HOROVOD_LOCKCHECK"] = prev_lockcheck
+    if prev_shards is None:
+        os.environ.pop(env_schema.HOROVOD_KV_SHARDS, None)
+    else:
+        os.environ[env_schema.HOROVOD_KV_SHARDS] = prev_shards
     if hung:
         raise RuntimeError(f"simulated ranks wedged: {hung}")
     if errs:
         raise RuntimeError(f"simulated ranks failed: {errs[:4]}")
     lat_ms = sorted(v * 1e3 for v in lat_s)
     n = len(lat_ms)
+    wire_bytes = sum(c.bytes_sent + c.bytes_received
+                     for c in ctls if c is not None)
+    total_rounds = rounds + 1  # + the warm/handshake round
     return {
         "ranks": nranks,
         "rounds": rounds,
+        "format": ctls[0].wire_format if ctls[0] is not None else "v1",
         "negotiate_p50_ms": round(lat_ms[(n - 1) // 2], 3),
         "negotiate_p95_ms": round(
             lat_ms[min(n - 1, round(0.95 * (n - 1)))], 3),
         "negotiate_max_ms": round(lat_ms[-1], 3),
+        "wire_bytes_total": wire_bytes,
+        "wire_bytes_per_rank_round": round(
+            wire_bytes / nranks / total_rounds, 1),
         "wall_s": round(time.perf_counter() - t_start, 3),
     }
 
 
+def load_budgets(ranks: int) -> dict:
+    """Static per-rank-count budgets banked in controller_budgets.json;
+    an unknown rank count falls back to the loosest entry."""
+    try:
+        with open(BUDGETS_PATH) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return table.get(str(ranks)) or table.get("default") or {}
+
+
 def budget_main(argv) -> int:
-    """``--budget`` mode: assert the simulated-pod negotiation p95
-    against a static bound via tools.benchguard (exit-code contract:
-    0 within budget, 1 breached)."""
+    """``--budget`` mode: run the flat/JSON and hierarchy/binary legs at
+    one rank count and assert the banked budgets via tools.benchguard
+    (exit-code contract: 0 within budget, 1 breached)."""
     import argparse
 
     from tools.benchguard import compare, exit_code
 
     ap = argparse.ArgumentParser(
         prog="controller_scaling --budget",
-        description="pod-scale negotiation latency budget gate")
+        description="pod-scale negotiation latency + scale-out gate")
     ap.add_argument("--ranks", type=int, default=64,
                     help="simulated world size (default 64)")
-    ap.add_argument("--rounds", type=int, default=30,
-                    help="measured rounds at rank 0 (default 30)")
-    ap.add_argument("--p95-ms", type=float, default=500.0,
-                    help="negotiation p95 budget in ms (default 500: "
-                         "~9x the quiet-host p95 at 64 simulated ranks "
-                         "(~57 ms), so a protocol regression toward "
-                         "O(size) polling trips it while a loaded CI "
-                         "host does not)")
+    ap.add_argument("--rounds", type=int, default=15,
+                    help="measured rounds at rank 0 (default 15)")
+    ap.add_argument("--p95-ms", type=float, default=None,
+                    help="override the flat-path p95 budget in ms "
+                         "(default: controller_budgets.json)")
+    ap.add_argument("--group-size", type=int, default=8,
+                    help="hierarchy group size (default 8)")
+    ap.add_argument("--shards", type=int, default=4,
+                    help="KV shards for the hierarchy leg (default 4)")
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="run each leg N times, keep its best p95 "
+                         "(CI noise damping; default 1)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
-    stats = simulate(args.ranks, args.rounds)
+    banked = load_budgets(args.ranks)
+    p95_budget = (args.p95_ms if args.p95_ms is not None
+                  else float(banked.get("p95_ms", 500.0)))
+
+    def leg(**kw):
+        # best-of-N per leg: a shared CI host's scheduler tail lands on
+        # either leg at random; the minimum p95 is the stable estimate
+        # of what the protocol costs (both legs get the same treatment)
+        runs = [simulate(args.ranks, args.rounds, **kw)
+                for _ in range(max(1, args.repeat))]
+        return min(runs, key=lambda r: r["negotiate_p95_ms"])
+
+    flat = leg()
+    hier = leg(hier=True, group_size=args.group_size,
+               shards=args.shards)
+    speedup = (flat["negotiate_p95_ms"] / hier["negotiate_p95_ms"]
+               if hier["negotiate_p95_ms"] > 0 else float("inf"))
+    reduction = (flat["wire_bytes_per_rank_round"]
+                 / hier["wire_bytes_per_rank_round"]
+                 if hier["wire_bytes_per_rank_round"] > 0
+                 else float("inf"))
     result = {"metric": "controller_sim_negotiate_p95_ms",
-              "value": stats["negotiate_p95_ms"], "unit": "ms",
-              "extras": stats}
-    verdict = compare(result, history=[],
-                      budgets=[("value", "<=", args.p95_ms)])
+              "value": flat["negotiate_p95_ms"], "unit": "ms",
+              "extras": {"flat": flat, "hier": hier,
+                         "hier_speedup": round(speedup, 3),
+                         "bytes_reduction": round(reduction, 3)}}
+    budgets = [("value", "<=", p95_budget)]
+    if "hier_speedup" in banked:
+        budgets.append(("extras.hier_speedup", ">=",
+                        float(banked["hier_speedup"])))
+    if "bytes_reduction" in banked:
+        budgets.append(("extras.bytes_reduction", ">=",
+                        float(banked["bytes_reduction"])))
+    verdict = compare(result, history=[], budgets=budgets)
     out = {"result": result, "verdict": verdict}
     if args.as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
     else:
         print(f"controller_scaling: {verdict['status'].upper()} — "
-              f"negotiate p95 {stats['negotiate_p95_ms']:g} ms over "
-              f"{args.ranks} simulated ranks (budget "
-              f"<={args.p95_ms:g} ms)")
+              f"{args.ranks} simulated ranks: flat p95 "
+              f"{flat['negotiate_p95_ms']:g} ms (budget <="
+              f"{p95_budget:g}), hier p95 {hier['negotiate_p95_ms']:g} "
+              f"ms ({speedup:.2f}x), wire {flat['wire_bytes_per_rank_round']:g}"
+              f" -> {hier['wire_bytes_per_rank_round']:g} B/rank-round "
+              f"({reduction:.2f}x)")
         for v in verdict["violations"]:
             print(f"  violation: {v}", file=sys.stderr)
     return exit_code(verdict)
+
+
+def sweep_main(argv) -> int:
+    """``--sweep``: the 64/256/1024 budget legs, one JSON line each
+    (the BENCH trajectory records these; 256 is the slow tier-1 gate)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="controller_scaling --sweep")
+    ap.add_argument("--ranks", type=int, nargs="*",
+                    default=[64, 256, 1024])
+    ap.add_argument("--rounds", type=int, default=15)
+    args = ap.parse_args(argv)
+    worst = 0
+    for nranks in args.ranks:
+        rc = budget_main(["--ranks", str(nranks),
+                          "--rounds", str(args.rounds), "--json"])
+        worst = max(worst, rc)
+    return worst
 
 
 def main():
     if "--budget" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--budget"]
         sys.exit(budget_main(argv))
+    if "--sweep" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--sweep"]
+        sys.exit(sweep_main(argv))
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     mp.set_start_method("spawn", force=True)
     print("| np | negotiate µs/round | steady-state µs/round "

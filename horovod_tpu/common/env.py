@@ -118,6 +118,16 @@ HOROVOD_DIAG_DIR = "HOROVOD_DIAG_DIR"
 HOROVOD_PERFLEDGER = "HOROVOD_PERFLEDGER"
 HOROVOD_PERFLEDGER_BUFFER = "HOROVOD_PERFLEDGER_BUFFER"
 HOROVOD_SLO_SPEC = "HOROVOD_SLO_SPEC"
+# control-plane scale-out (ops/controller.py, ops/wire.py,
+# runner/http_server.py; docs/scaling.md): hierarchical node-leader
+# negotiation + binary wire-format v2 master switch, ranks per leader
+# group (pods: set to the per-host process count), how long a member
+# waits on its leader before falling back to flat submission, and the
+# rendezvous KV shard count (listener sockets/stores in the launcher)
+HOROVOD_HIER_NEGOTIATION = "HOROVOD_HIER_NEGOTIATION"
+HOROVOD_HIER_GROUP_SIZE = "HOROVOD_HIER_GROUP_SIZE"
+HOROVOD_HIER_FALLBACK_S = "HOROVOD_HIER_FALLBACK_S"
+HOROVOD_KV_SHARDS = "HOROVOD_KV_SHARDS"
 # device-memory & compile ledger (utils/memledger.py;
 # docs/observability.md "Memory & compile ledger"): master switch and
 # sample-ring capacity, plus an optional byte cap on the compiled-plan
@@ -253,6 +263,13 @@ class RuntimeConfig:
     memledger_enabled: bool = False
     memledger_buffer: int = 512
     plan_cache_max_bytes: int = 0
+    # control-plane scale-out (ops/controller.py + runner/http_server.py)
+    # — off by default: the negotiation wire is byte-identical to the
+    # flat/JSON v1 protocol and no hvd_hier_*/wire-v2 series exist
+    hier_negotiation: bool = False
+    hier_group_size: int = 8
+    hier_fallback_s: float = 5.0
+    kv_shards: int = 1
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -314,4 +331,10 @@ class RuntimeConfig:
                                      c.memledger_buffer)
         c.plan_cache_max_bytes = get_int(HOROVOD_PLAN_CACHE_MAX_BYTES,
                                          c.plan_cache_max_bytes)
+        c.hier_negotiation = get_bool(HOROVOD_HIER_NEGOTIATION)
+        c.hier_group_size = get_int(HOROVOD_HIER_GROUP_SIZE,
+                                    c.hier_group_size)
+        c.hier_fallback_s = get_float(HOROVOD_HIER_FALLBACK_S,
+                                      c.hier_fallback_s)
+        c.kv_shards = get_int(HOROVOD_KV_SHARDS, c.kv_shards)
         return c

@@ -38,6 +38,19 @@ Rounds advance in lockstep; scope r-2 is garbage-collected by rank 0, and
 a starting coordinator purges every dead generation under ctl/ (its own
 prefix excluded).
 
+Scale-out mode (HOROVOD_HIER_NEGOTIATION, docs/scaling.md): workers
+advertise wire v2 in their round-0 submission ("wv": 2); when EVERY rank
+advertised it the coordinator confirms in the round-0 response and from
+round 1 on the payloads are the compact binary frames of ops/wire.py and
+ranks submit through a deterministic per-group leader (rank // k * k),
+which merges the group into one rank-bitmap aggregate
+(P/r{r}/ready/g{gid}) and fans the coordinator's response back down
+(P/r{r}/g{gid}/resp). A missing/slow leader is survived per round: the
+member re-submits flat after HOROVOD_HIER_FALLBACK_S and stays flat for
+a backoff window, so coordinator fan-in degrades from O(N/k) back toward
+O(N) but no round is ever lost. Mixed worlds (any rank without "wv")
+stay on v1, and with the flag off the wire is byte-identical to v1.
+
 Join semantics (reference JoinOp, collective_operations.h:271 +
 global_state.h:107-111 "joined ranks contribute zeros"): a joined rank keeps
 negotiating with ``j=true`` and counts as an implicit submitter for every
@@ -59,12 +72,18 @@ from typing import Optional
 from ..common import env as env_schema
 from ..utils import diag as diag_mod
 from ..utils import faults as faults_mod
+from ..utils import flightrec as flightrec_mod
 from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import retry as retry_mod
 from ..utils import tracing as tracing_mod
+from . import wire as wire_mod
 
 LOG = logging.getLogger("horovod_tpu")
+
+#: First byte of every v2 binary frame (sniffed against raw payloads —
+#: v1 JSON starts with ``{``/``[`` and the marker with ``=``).
+_MAGIC_BYTE = bytes((wire_mod.MAGIC_V2,))
 
 
 def _ctl_prefix() -> str:
@@ -87,6 +106,18 @@ def _ctl_prefix() -> str:
 
 def _ctl_scope(r: int) -> str:
     return f"{_ctl_prefix()}/r{r}"
+
+
+def _source_order(suffix: str):
+    """Deterministic processing order for a round's submission sources:
+    flat ranks first (numeric), then leader aggregates ("g<id>"); None
+    for foreign keys under the ready/ prefix (skipped, as v1 skipped
+    non-integer suffixes)."""
+    if suffix.isdigit():
+        return (0, int(suffix))
+    if suffix[:1] == "g" and suffix[1:].isdigit():
+        return (1, int(suffix[1:]))
+    return None
 
 
 def entry_signature(entry) -> list:
@@ -162,10 +193,20 @@ class KVController:
 
     on_params = None  # callable(dict) applied at response receipt
 
+    # After a leader let a member (or its own merge) down, ranks submit
+    # flat for this many rounds before re-trying the hierarchy — a dead
+    # leader must not cost a fallback timeout every round, and the whole
+    # group re-converges on the same round (everyone backs off from the
+    # round that failed).
+    FLAT_BACKOFF_ROUNDS = 16
+
     def __init__(self, client, rank: int, size: int,
                  poll_timeout: float = RESPONSE_TIMEOUT_S,
                  stall_warning_s: float = 60.0,
-                 stall_shutdown_s: float = 0.0):
+                 stall_shutdown_s: float = 0.0,
+                 hier: Optional[bool] = None,
+                 hier_group_size: Optional[int] = None,
+                 hier_fallback_s: Optional[float] = None):
         self.client = client
         self.rank = rank
         self.size = size
@@ -176,7 +217,33 @@ class KVController:
         # observability: wire bytes + fast-path round count (testable proxy
         # for "negotiation cost is O(1) in steady state")
         self.bytes_sent = 0
+        self.bytes_received = 0
         self.fast_rounds = 0
+        # hierarchical scale-out (docs/scaling.md) — until the round-0
+        # version handshake completes, everything below is dormant and the
+        # v1 wire is byte-identical to a build without this code
+        if hier is None:
+            hier = env_schema.get_bool(env_schema.HOROVOD_HIER_NEGOTIATION)
+        self._hier = bool(hier)
+        k = (hier_group_size if hier_group_size is not None
+             else env_schema.get_int(env_schema.HOROVOD_HIER_GROUP_SIZE, 8))
+        self._group_size = max(1, int(k))
+        self._fallback_s = float(
+            hier_fallback_s if hier_fallback_s is not None
+            else env_schema.get_float(env_schema.HOROVOD_HIER_FALLBACK_S,
+                                      5.0))
+        self._group = rank // self._group_size
+        self._group_ranks = list(range(
+            self._group * self._group_size,
+            min((self._group + 1) * self._group_size, size)))
+        self._member_set = set(self._group_ranks)
+        self._wire_version = 1
+        self._resp_dec: Optional[wire_mod.ResponseDecoder] = None
+        self._last_channel = "flat"  # which cache holds _last_payload
+        self._last_agg: Optional[bytes] = None
+        self._member_cache: dict[int, dict] = {}  # leader-side marker cache
+        self._flat_until = 0
+        self._m_wire_v2: dict = {}  # direction -> labeled counter, lazy
         reg = metrics_mod.get_registry()
         # cache hit = SAME_AS_LAST marker round (the response-cache role);
         # miss = a full re-serialized payload
@@ -219,37 +286,13 @@ class KVController:
             raise RuntimeError("controller is broken; re-initialize horovod_tpu")
         r = self.round
         try:
-            # the base payload (no timestamp) is what the SAME_AS_LAST
-            # comparison sees: a per-round submit time must not break the
-            # 1-byte steady-state fast path
-            payload = json.dumps(
-                {"e": [[n, sig] for n, sig in pending.items()],
-                 "j": bool(joined), "sd": bool(shutting_down)}).encode()
-            t_sub = (self._tracer.aligned_now()
-                     if self._tracer is not None and pending else None)
-            if payload == self._last_payload:
-                # fast round; with tracing on, the marker carries a tiny
-                # timestamp suffix the coordinator strips (still O(1) and
-                # signature-free — the cached submission decodes the set)
-                wire = self.SAME_AS_LAST
-                if t_sub is not None:
-                    wire += json.dumps({"t": t_sub}).encode()
-                self.fast_rounds += 1
-                self._m_cache_hit.inc()
+            if self._wire_version >= wire_mod.WIRE_V2:
+                raw = self._round_v2(r, pending, joined, shutting_down)
+                self._wire_count("rx", len(raw))
             else:
-                wire = payload
-                if t_sub is not None:
-                    wire = json.dumps(
-                        {"e": [[n, sig] for n, sig in pending.items()],
-                         "j": bool(joined), "sd": bool(shutting_down),
-                         "t": t_sub}).encode()
-                self._m_cache_miss.inc()
-            faults_mod.fault_point("controller.submit")
-            self.client.put(_ctl_scope(r), f"ready/{self.rank}", wire)
-            self.bytes_sent += len(wire)
-            self._m_wire_bytes.inc(len(wire))
-            self._last_payload = payload
-            resp = json.loads(self._poll_response(r))
+                raw = self._round_v1(r, pending, joined, shutting_down)
+            self.bytes_received += len(raw)
+            resp = self._decode_response(raw)
         except Exception:
             self.broken = True
             raise
@@ -263,6 +306,7 @@ class KVController:
             # coordinator dropped its submission cache (error-closed
             # round): the next round must carry a full payload
             self._last_payload = None
+            self._last_agg = None
         resp.setdefault("errors", {})
         resp.setdefault("sigs", {})
         resp.setdefault("join_done", None)
@@ -279,7 +323,320 @@ class KVController:
                 self.on_params(resp["params"])
             except Exception as e:  # tuning must never break the lockstep
                 LOG.warning("on_params failed: %s", e)
+        if (self._wire_version < wire_mod.WIRE_V2
+                and int(resp.get("wv") or 1) >= wire_mod.WIRE_V2):
+            # round-0 handshake complete: every rank advertised v2 and the
+            # coordinator confirmed — binary frames + hierarchy from the
+            # next round. Fresh caches: markers never cross wire formats.
+            self._wire_version = wire_mod.WIRE_V2
+            self._resp_dec = wire_mod.ResponseDecoder()
+            self._last_payload = None
+            self._last_agg = None
         return resp
+
+    def _round_v1(self, r: int, pending: dict, joined: bool,
+                  shutting_down: bool) -> bytes:
+        """Legacy flat JSON round — byte-identical to the pre-hierarchy
+        wire except for the one-time round-0 ``"wv"`` version advert
+        (present only when HOROVOD_HIER_NEGOTIATION is on)."""
+        # the base payload (no timestamp) is what the SAME_AS_LAST
+        # comparison sees: a per-round submit time must not break the
+        # 1-byte steady-state fast path
+        base = {"e": [[n, sig] for n, sig in pending.items()],
+                "j": bool(joined), "sd": bool(shutting_down)}
+        if self._hier and r == 0:
+            base["wv"] = wire_mod.WIRE_V2
+        payload = json.dumps(base).encode()
+        t_sub = (self._tracer.aligned_now()
+                 if self._tracer is not None and pending else None)
+        if payload == self._last_payload:
+            # fast round; with tracing on, the marker carries a tiny
+            # timestamp suffix the coordinator strips (still O(1) and
+            # signature-free — the cached submission decodes the set)
+            w = self.SAME_AS_LAST
+            if t_sub is not None:
+                w += json.dumps({"t": t_sub}).encode()
+            self.fast_rounds += 1
+            self._m_cache_hit.inc()
+        else:
+            w = payload
+            if t_sub is not None:
+                w = json.dumps(dict(base, t=t_sub)).encode()
+            self._m_cache_miss.inc()
+        faults_mod.fault_point("controller.submit")
+        self.client.put(_ctl_scope(r), f"ready/{self.rank}", w)
+        self.bytes_sent += len(w)
+        self._m_wire_bytes.inc(len(w))
+        self._last_payload = payload
+        return self._poll_response(r)
+
+    # -- wire v2 / hierarchical rounds ------------------------------------
+
+    def _decode_response(self, raw: bytes) -> dict:
+        """Sniff the response frame: v2 binary when it is one, else the
+        v1 JSON shapes (normal, error-close, abort — the coordinator
+        keeps failure responses in JSON in every mode, so they never
+        carry interning state a broken world could lose)."""
+        if raw[:1] == _MAGIC_BYTE and self._resp_dec is not None:
+            return self._resp_dec.decode(raw)
+        return json.loads(raw)
+
+    def _wire_count(self, direction: str, n: int) -> None:
+        c = self._m_wire_v2.get(direction)
+        if c is None:
+            c = self._m_wire_v2[direction] = \
+                metrics_mod.get_registry().counter(
+                    "hvd_controller_wire_bytes_total",
+                    "negotiation submission bytes put to the KV store",
+                    direction=direction, format="v2")
+        c.inc(n)
+
+    def _sent(self, w: bytes) -> None:
+        self.bytes_sent += len(w)
+        self._wire_count("tx", len(w))
+
+    @property
+    def wire_format(self) -> str:
+        """"v1" or "v2" — what this controller currently speaks."""
+        return "v2" if self._wire_version >= wire_mod.WIRE_V2 else "v1"
+
+    def _round_v2(self, r: int, pending: dict, joined: bool,
+                  shutting_down: bool) -> bytes:
+        entries = [(n, sig) for n, sig in pending.items()]
+        t_sub = (self._tracer.aligned_now()
+                 if self._tracer is not None and pending else None)
+        if self.rank == self._group_ranks[0]:
+            return self._leader_round(r, entries, joined, shutting_down,
+                                      t_sub)
+        if r < self._flat_until:
+            return self._flat_round(r, entries, joined, shutting_down, t_sub)
+        return self._member_round(r, entries, joined, shutting_down, t_sub)
+
+    def _flat_round(self, r: int, entries, joined, shutting_down,
+                    t_sub) -> bytes:
+        """v2-framed submission straight to the coordinator — the
+        fallback topology (and the leader's own path while backed off)."""
+        payload = wire_mod.encode_submission(entries, joined, shutting_down)
+        if payload == self._last_payload and self._last_channel == "flat":
+            w = self.SAME_AS_LAST
+            if t_sub is not None:
+                w += json.dumps({"t": t_sub}).encode()
+            self.fast_rounds += 1
+            self._m_cache_hit.inc()
+        else:
+            w = (payload if t_sub is None else
+                 wire_mod.encode_submission(entries, joined, shutting_down,
+                                            t=t_sub))
+            self._m_cache_miss.inc()
+        faults_mod.fault_point("controller.submit")
+        self.client.put(_ctl_scope(r), f"ready/{self.rank}", w)
+        self._sent(w)
+        self._last_payload = payload
+        self._last_channel = "flat"
+        return self._poll_response(r)
+
+    def _member_round(self, r: int, entries, joined, shutting_down,
+                      t_sub) -> bytes:
+        """Submit through the group leader; fall back to a flat round if
+        the fan-down response never comes (leader dead or wedged)."""
+        gscope = f"{_ctl_scope(r)}/g{self._group}"
+        payload = wire_mod.encode_submission(entries, joined, shutting_down)
+        if payload == self._last_payload and self._last_channel == "group":
+            w = self.SAME_AS_LAST
+            if t_sub is not None:
+                w += json.dumps({"t": t_sub}).encode()
+            self.fast_rounds += 1
+            self._m_cache_hit.inc()
+        else:
+            w = (payload if t_sub is None else
+                 wire_mod.encode_submission(entries, joined, shutting_down,
+                                            t=t_sub))
+            self._m_cache_miss.inc()
+        faults_mod.fault_point("controller.submit")
+        deadline = min(self._fallback_s, self.poll_timeout)
+        put_get = getattr(self.client, "put_get", None)
+        try:
+            if put_get is not None:
+                # one exchange: submit + park on the fan-down key (the
+                # control plane is exchange-count-bound at pod scale)
+                raw = put_get(gscope, f"ready/{self.rank}", w, "resp",
+                              timeout=deadline)
+            else:
+                self.client.put(gscope, f"ready/{self.rank}", w)
+                raw = self.client.get(gscope, "resp", timeout=deadline)
+            self._sent(w)
+            self._last_payload = payload
+            self._last_channel = "group"
+            return raw
+        except Exception:
+            # leader suspect: resubmit flat so the round cannot lose this
+            # rank's tensors, and stay flat for a backoff window
+            self._flat_until = r + self.FLAT_BACKOFF_ROUNDS
+            self._last_payload = None
+            rec = flightrec_mod.get_recorder()
+            if rec is not None:
+                rec.note("leader_round", role="member", round=r,
+                         group=self._group, fallback=True)
+            raw = self._flat_round(r, entries, joined, shutting_down, t_sub)
+            # the coordinator may have closed the round off the leader's
+            # aggregate without ever reading the flat resubmission, so its
+            # flat cache for this rank is not trustworthy yet: markers
+            # resume only after a clean flat round
+            self._last_payload = None
+            return raw
+
+    def _leader_round(self, r: int, entries, joined, shutting_down,
+                      t_sub) -> bytes:
+        """Gather the group, PUT one aggregate to the coordinator, fan
+        the response back down. Any merge/submit failure degrades to a
+        flat round (members re-submit flat on their own timeout), so a
+        chaos-killed leader stalls a round but never desyncs it."""
+        if r < self._flat_until:
+            return self._flat_round(r, entries, joined, shutting_down, t_sub)
+        gscope = f"{_ctl_scope(r)}/g{self._group}"
+        members = self._group_ranks[1:]
+        raw = None
+        try:
+            w, covered = self._merge_group(r, gscope, members, entries,
+                                           joined, shutting_down, t_sub)
+            faults_mod.fault_point("controller.submit")
+            put_get = getattr(self.client, "put_get", None)
+            if put_get is not None:
+                # submit the aggregate and park on the response in one
+                # exchange; a 404 deadline means the PUT landed and the
+                # round is just not closed yet — keep polling plainly
+                try:
+                    raw = put_get(
+                        _ctl_scope(r), f"ready/g{self._group}", w, "resp",
+                        timeout=max(0.1, min(self.POLL_ATTEMPT_S,
+                                             self.poll_timeout / 4.0)))
+                except Exception as e:
+                    if getattr(e, "code", None) != 404:
+                        raise
+            else:
+                self.client.put(_ctl_scope(r), f"ready/g{self._group}", w)
+            self._sent(w)
+        except Exception:
+            self._last_agg = None
+            self._last_payload = None
+            self._flat_until = r + self.FLAT_BACKOFF_ROUNDS
+            rec = flightrec_mod.get_recorder()
+            if rec is not None:
+                rec.note("leader_round", role="leader", round=r,
+                         group=self._group, fallback=True)
+            raw = self._flat_round(r, entries, joined, shutting_down, t_sub)
+            self._last_payload = None
+            return raw
+        if members and len(covered) == 1:
+            # no member made it into the aggregate: they are flat (or
+            # gone) — stop burning the gather deadline every round and
+            # re-converge with their backoff window
+            self._flat_until = r + self.FLAT_BACKOFF_ROUNDS
+        if raw is None:
+            raw = self._poll_response(r)
+        if members:
+            # members are parked on the group resp key: fan down before
+            # local processing so they unblock first
+            self.client.put(gscope, "resp", raw)
+            self._sent(raw)
+        rec = flightrec_mod.get_recorder()
+        if rec is not None:
+            rec.note("leader_round", role="leader", round=r,
+                     group=self._group, covered=len(covered), bytes=len(w))
+        return raw
+
+    def _merge_group(self, r: int, gscope: str, members, entries,
+                     joined, shutting_down, t_sub):
+        """Collect member submissions (partial results after the
+        fallback deadline are fine — an uncovered member re-submits flat
+        on its own), merge them with this leader's set, and return
+        ``(wire_bytes, covered_ranks)``. The aggregate gets the same
+        SAME_AS_LAST treatment as a flat payload: byte-deterministic
+        encoding compared against last round's."""
+        got: dict[int, bytes] = {}
+        if members:
+            try:
+                raw_map = self.client.get_prefix(
+                    gscope, "ready/", min_count=len(members),
+                    timeout=min(self._fallback_s, self.poll_timeout))
+            except Exception:
+                raw_map = {}
+            for suffix, raw in raw_map.items():
+                try:
+                    k = int(suffix)
+                except ValueError:
+                    continue  # foreign key under the prefix
+                if k != self.rank and k in self._member_set:
+                    got[k] = raw
+        faults_mod.fault_point("leader.merge")
+        merged: dict = {}  # (name, canonical sig) -> [name, sig, ranks]
+        order: list = []
+        covered = {self.rank}
+        j_set = {self.rank} if joined else set()
+        sd_set = {self.rank} if shutting_down else set()
+        t_map = {} if t_sub is None else {self.rank: t_sub}
+
+        def add(name, sig, k):
+            key = (name, json.dumps(sig))
+            ent = merged.get(key)
+            if ent is None:
+                merged[key] = [name, sig, {k}]
+                order.append(key)
+            else:
+                ent[2].add(k)
+
+        for name, sig in entries:
+            add(name, sig, self.rank)
+        for k in sorted(got):
+            raw = got[k]
+            t_k = None
+            if raw[:1] == self.SAME_AS_LAST:
+                msg = self._member_cache.get(k)
+                if msg is None:
+                    # nothing cached to expand the marker with: leave the
+                    # rank uncovered — it flat-falls-back when the group
+                    # resp never frees it (never claim ranks we cannot
+                    # actually decode)
+                    continue
+                if len(raw) > 1:
+                    try:
+                        t_k = float(json.loads(raw[1:])["t"])
+                    except (ValueError, TypeError, KeyError):
+                        t_k = None
+            else:
+                try:
+                    msg = wire_mod.decode_submission(raw)
+                except wire_mod.WireDecodeError:
+                    continue  # torn frame: uncovered, member re-sends flat
+                t_k = msg.pop("t", None)
+                self._member_cache[k] = msg
+            covered.add(k)
+            if msg.get("j"):
+                j_set.add(k)
+            if msg.get("sd"):
+                sd_set.add(k)
+            if t_k is not None:
+                t_map[k] = float(t_k)
+            for name, sig in msg.get("e", []):
+                add(name, sig, k)
+        items = [tuple(merged[key]) for key in order]
+        base = wire_mod.encode_aggregate(self._group, self.size, items,
+                                         covered, j_set, sd_set)
+        if base == self._last_agg:
+            w = self.SAME_AS_LAST
+            if t_map:
+                w += json.dumps(
+                    {"t": {str(k): v for k, v in t_map.items()}}).encode()
+            self.fast_rounds += 1
+            self._m_cache_hit.inc()
+        else:
+            w = (base if not t_map else
+                 wire_mod.encode_aggregate(self._group, self.size, items,
+                                           covered, j_set, sd_set,
+                                           t_map=t_map))
+            self._m_cache_miss.inc()
+        self._last_agg = base
+        return w, covered
 
     def _poll_response(self, r: int) -> bytes:
         """Block for round ``r``'s response under the unified retry
@@ -370,8 +727,18 @@ class _Coordinator(threading.Thread):
         self._pending_params = None  # guarded-by: _params_lock
         self._params_lock = lockcheck.make_lock("controller.params")
         self._down: set[int] = set()
-        # rank -> last full submission (for SAME_AS_LAST fast-path decode)
-        self._last_submission: dict[int, dict] = {}
+        # source key ("3" = flat rank, "g1" = leader aggregate) -> cached
+        # contribution for SAME_AS_LAST fast-path decode, in the unified
+        # shape of _decode_contribution (sans the per-round "t" map)
+        self._last_submission: dict[str, dict] = {}
+        # wire v2: flipped after the round-0 handshake confirms every
+        # rank advertised it; the encoder interns across rounds
+        self._wire_v2 = False
+        self._resp_enc: Optional[wire_mod.ResponseEncoder] = None
+        self._m_fanin = None  # hvd_negotiation_fanin, lazy (zero-cost off)
+        # adaptive bulk-read target: how many distinct sources closed the
+        # last round (size when flat, ~size/k under hierarchy)
+        self._expected_sources = size
         # join tracking (reference JoinOp: joined_size / joined ranks,
         # global_state.h:107-111)
         self._joined: set[int] = set()
@@ -457,18 +824,104 @@ class _Coordinator(threading.Thread):
                         json.dumps({"ready": [], "errors": errors,
                                     "invalidate": True}).encode())
 
-    def _gather_round(self, r: int) -> Optional[dict[int, bytes]]:
-        """Collect every rank's round-r submission, attributing stalls.
-        Returns None when stopping or after an error-close."""
+    def _decode_contribution(self, source: str, raw: bytes) -> dict:
+        """Decode one submission source into the unified contribution
+        shape ``{"entries": [(name, sig, ranks)], "covered": set,
+        "j": set, "sd": set, "wv": int, "t": {rank: time}}`` —
+        format-sniffed per frame (marker / v2 binary / v1 JSON), so a
+        flat-fallback rank and a leader aggregate coexist in one round.
+        Caches the decoded contribution (sans "t") for markers."""
+        if raw[:1] == KVController.SAME_AS_LAST:
+            base = self._last_submission.get(source)
+            if base is None:
+                # marker with nothing cached: same default as v1 — an
+                # empty submission that still covers a flat rank (a group
+                # marker can claim nothing)
+                base = {"entries": [], "j": set(), "sd": set(), "wv": 1,
+                        "covered": (set() if source[:1] == "g"
+                                    else {int(source)})}
+            t_map: dict = {}
+            if len(raw) > 1:
+                # tracing: marker + {"t": ...} suffix — a float for a
+                # flat rank, {rank: float} for an aggregate
+                try:
+                    t = json.loads(raw[1:])["t"]
+                    if isinstance(t, dict):
+                        t_map = {int(k): float(v) for k, v in t.items()}
+                    else:
+                        t_map = {int(source): float(t)}
+                except (ValueError, TypeError, KeyError):
+                    t_map = {}
+            return dict(base, t=t_map)
+        if raw[:1] == _MAGIC_BYTE:
+            if wire_mod.is_aggregate(raw):
+                m = wire_mod.decode_aggregate(raw)
+                contrib = {"entries": [(n, sig, set(ranks))
+                                       for n, sig, ranks in m["e"]],
+                           "covered": set(m["covered"]),
+                           "j": set(m["j"]), "sd": set(m["sd"]),
+                           "wv": wire_mod.WIRE_V2}
+                t_map = {int(k): float(v)
+                         for k, v in (m.get("t") or {}).items()}
+            else:
+                m = wire_mod.decode_submission(raw)
+                k = int(source)
+                t = m.pop("t", None)
+                contrib = {"entries": [(n, sig, {k}) for n, sig in m["e"]],
+                           "covered": {k},
+                           "j": {k} if m.get("j") else set(),
+                           "sd": {k} if m.get("sd") else set(),
+                           "wv": wire_mod.WIRE_V2}
+                t_map = {} if t is None else {k: float(t)}
+        else:
+            msg = json.loads(raw)
+            if isinstance(msg, list):  # tolerate bare entry lists
+                msg = {"e": msg, "j": False}
+            k = int(source)
+            t = msg.pop("t", None)  # per-round, not part of the
+            t_map = {}              # cached submission set
+            if t is not None:
+                try:
+                    t_map = {k: float(t)}
+                except (TypeError, ValueError):
+                    t_map = {}
+            contrib = {"entries": [(n, sig, {k})
+                                   for n, sig in msg.get("e", [])],
+                       "covered": {k},
+                       "j": {k} if msg.get("j") else set(),
+                       "sd": {k} if msg.get("sd") else set(),
+                       "wv": int(msg.get("wv") or 1)}
+        self._last_submission[source] = contrib
+        return dict(contrib, t=t_map)
+
+    def _gather_round(self, r: int) -> Optional[list]:
+        """Collect submissions until every rank is covered (a flat source
+        covers one rank, an aggregate its bitmap), attributing stalls to
+        the genuinely missing ranks. Returns the decoded contributions as
+        an ordered ``[(source, contribution)]`` list, or None when
+        stopping or after an error-close."""
         import time as _time
 
-        got: dict[int, bytes] = {}
-        missing = set(range(self.size))
+        got: dict[str, dict] = {}
+        covered: set[int] = set()
+        world = set(range(self.size))
         start = _time.monotonic()
         warned_at = 0.0
-        while missing and not self._stop_evt.is_set():
-            # One bulk read per poll: the store blocks until all `size`
-            # submissions exist (or POLL_TIMEOUT_S passes and partial
+        # the bulk-read target adapts to the fan-in: all `size` flat
+        # sources in v1, ~size/k aggregates under hierarchy (learned from
+        # the previous round — one mis-sized poll converges it)
+        min_count = max(1, min(self._expected_sources, self.size))
+        # The store's blocking prefix-read wakes the moment min_count
+        # submissions exist, so a short first slice costs nothing on the
+        # fast path — but when min_count OVERestimates the fan-in (the
+        # one round where the world switches from flat sources to
+        # aggregates, shrinking sources k-fold) it bounds the stall to
+        # ~50ms instead of a full poll interval. The slice ramps back up
+        # so genuine straggler waits don't busy-rescan.
+        poll_s = 0.05
+        while covered != world and not self._stop_evt.is_set():
+            # One bulk read per poll: the store blocks until min_count
+            # submissions exist (or the poll slice passes and partial
             # results return for stall attribution). Role of the
             # reference's single MPI_Gatherv fan-in
             # (mpi_controller.cc:108) — N sequential GETs per round made
@@ -476,28 +929,30 @@ class _Coordinator(threading.Thread):
             bulk = getattr(self.client, "get_prefix", None)
             if bulk is not None:
                 try:
-                    for suffix, raw in bulk(
-                            _ctl_scope(r), "ready/",
-                            min_count=self.size,
-                            timeout=self.POLL_TIMEOUT_S).items():
-                        try:
-                            k = int(suffix)
-                        except ValueError:
-                            continue  # foreign key under the prefix
-                        if k in missing:
-                            got[k] = raw
-                            missing.discard(k)
+                    raw_map = bulk(_ctl_scope(r), "ready/",
+                                   min_count=min_count,
+                                   timeout=poll_s)
                 except Exception:
                     bulk = None  # store without prefix-read support
+                    raw_map = {}
+                for suffix, raw in raw_map.items():
+                    if suffix in got or _source_order(suffix) is None:
+                        continue
+                    contrib = self._decode_contribution(suffix, raw)
+                    got[suffix] = contrib
+                    covered |= contrib["covered"]
             if bulk is None:
-                for k in sorted(missing):
+                for k in sorted(world - covered):
                     try:
-                        got[k] = self.client.get(
+                        raw = self.client.get(
                             _ctl_scope(r), f"ready/{k}",
                             timeout=self.POLL_TIMEOUT_S)
-                        missing.discard(k)
                     except Exception:
                         continue  # straggler: keep polling this rank
+                    contrib = self._decode_contribution(str(k), raw)
+                    got[str(k)] = contrib
+                    covered |= contrib["covered"]
+            missing = world - covered
             elapsed = _time.monotonic() - start
             self._gather_state = {"round": r,
                                   "missing_ranks": sorted(missing),
@@ -510,8 +965,13 @@ class _Coordinator(threading.Thread):
                 self._error_close_round(r, missing, elapsed)
                 self._gather_state = {}
                 return None
+            min_count = min(self.size, len(got) + 1)
+            poll_s = min(self.POLL_TIMEOUT_S, poll_s * 4)
         self._gather_state = {}
-        return got if not missing else None
+        if covered != world:
+            return None
+        self._expected_sources = max(1, len(got))
+        return sorted(got.items(), key=lambda kv: _source_order(kv[0]))
 
     def run(self):
         try:
@@ -527,42 +987,22 @@ class _Coordinator(threading.Thread):
         while not self._stop_evt.is_set():
             try:
                 resp_published = False
-                got = self._gather_round(r)
-                if got is None:
+                contribs = self._gather_round(r)
+                if contribs is None:
                     if self._stop_evt.is_set():
                         return
                     r += 1  # error-closed round: lockstep advances
                     continue
-                for k in sorted(got):
-                    raw = got[k]
-                    t_sub = None
-                    if raw[:1] == KVController.SAME_AS_LAST:
-                        msg = self._last_submission.get(k, {"e": [], "j": False})
-                        if len(raw) > 1:
-                            # tracing: marker + {"t": submit_time} suffix —
-                            # the cached submission still decodes the set
-                            try:
-                                t_sub = float(json.loads(raw[1:])["t"])
-                            except (ValueError, TypeError, KeyError):
-                                t_sub = None
-                    else:
-                        msg = json.loads(raw)
-                        if isinstance(msg, list):  # tolerate bare entry lists
-                            msg = {"e": msg, "j": False}
-                        t = msg.pop("t", None)  # per-round, not part of the
-                        if t is not None:       # cached submission set
-                            try:
-                                t_sub = float(t)
-                            except (TypeError, ValueError):
-                                t_sub = None
-                        self._last_submission[k] = msg
-                    if msg.get("j") and k not in self._joined:
-                        self._joined.add(k)
-                        self._last_joined_rank = k
-                    if msg.get("sd"):
-                        self._down.add(k)
-                    for name, sig in msg.get("e", []):
-                        self._increment(name, sig, k, t_sub)
+                for source, contrib in contribs:
+                    t_map = contrib.get("t") or {}
+                    for k in sorted(contrib["j"]):
+                        if k not in self._joined:
+                            self._joined.add(k)
+                            self._last_joined_rank = k
+                    self._down |= contrib["sd"]
+                    for name, sig, ranks in contrib["entries"]:
+                        for k in sorted(ranks):
+                            self._increment(name, sig, k, t_map.get(k))
                 self._check_stalled_tensors()
                 # A tensor is ready when every rank either submitted it or
                 # has joined (joined ranks are implicit zero contributors,
@@ -576,8 +1016,8 @@ class _Coordinator(threading.Thread):
                     join_done = self._last_joined_rank
                     self._joined.clear()
                     self._last_joined_rank = -1
-                    for k in self._last_submission.values():
-                        k["j"] = False
+                    for c in self._last_submission.values():
+                        c["j"] = set()
                 errors = {n: self.errors[n] for n in list(self.errors)}
                 sigs = {n: self.table[n][0] for n in ready}
                 strag = self._attribute_stragglers(ready)
@@ -606,14 +1046,40 @@ class _Coordinator(threading.Thread):
                     if self._pending_params is not None:
                         resp_dict["params"] = self._pending_params
                         self._pending_params = None
-                self.client.put(_ctl_scope(r), "resp",
-                                json.dumps(resp_dict).encode())
+                if (r == 0 and not self._wire_v2 and contribs
+                        and all(c.get("wv", 1) >= wire_mod.WIRE_V2
+                                for _, c in contribs)):
+                    # every rank advertised the binary wire in round 0:
+                    # confirm in the (still-JSON) response and switch —
+                    # any rank without "wv" keeps the whole world on v1
+                    resp_dict["wv"] = wire_mod.WIRE_V2
+                if self._resp_enc is not None:
+                    raw_resp = self._resp_enc.encode(resp_dict)
+                else:
+                    raw_resp = json.dumps(resp_dict).encode()
+                self.client.put(_ctl_scope(r), "resp", raw_resp)
                 resp_published = True
+                if resp_dict.get("wv"):
+                    self._wire_v2 = True
+                    self._resp_enc = wire_mod.ResponseEncoder()
                 self._m_responses.inc()
                 self._m_ready.inc(len(ready))
                 self._m_errors.inc(len(errors))
+                if self._wire_v2:
+                    if self._m_fanin is None:
+                        self._m_fanin = metrics_mod.get_registry().gauge(
+                            "hvd_negotiation_fanin",
+                            "submission sources the coordinator merged in "
+                            "the last negotiation round")
+                    self._m_fanin.set(len(contribs))
                 if r >= 2:
-                    self.client.delete_scope(_ctl_scope(r - 2))
+                    if self._wire_v2:
+                        # group sub-scopes hash to their own KV shards: a
+                        # prefix delete (broadcast when sharded) sweeps
+                        # them; delete_scope would only reach one shard
+                        self.client.delete_prefix(_ctl_scope(r - 2) + "/")
+                    else:
+                        self.client.delete_scope(_ctl_scope(r - 2))
                 if resp_dict.get("shutdown_done"):
                     return  # all ranks drained: the lockstep is over
                 r += 1

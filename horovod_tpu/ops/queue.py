@@ -340,14 +340,19 @@ class BackgroundRuntime:
             cfg = ctx_mod.context().config
             warn_s, shut_s = cfg.stall_warning_time_s, cfg.stall_shutdown_time_s
             resp_s = cfg.response_timeout_s
+            hier = cfg.hier_negotiation
+            hier_k, hier_fb = cfg.hier_group_size, cfg.hier_fallback_s
         except Exception:
             warn_s, shut_s, resp_s = 60.0, 0.0, KVController.RESPONSE_TIMEOUT_S
+            hier, hier_k, hier_fb = None, None, None
         return KVController(KVStoreClient(addr, int(port)),
                             rank=self.process_set.cross_rank,
                             size=self.process_set.cross_size,
                             poll_timeout=resp_s,
                             stall_warning_s=warn_s,
-                            stall_shutdown_s=shut_s)
+                            stall_shutdown_s=shut_s,
+                            hier=hier, hier_group_size=hier_k,
+                            hier_fallback_s=hier_fb)
 
     def _op_metrics(self, op: str, dtype: str) -> tuple:
         """(bytes_total, latency_hist, ops_total) for one (op, dtype) —

@@ -23,8 +23,10 @@ does not hold the key.
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.error import HTTPError
@@ -112,9 +114,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         if not self._authorized(body):
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
-        with store.cond:
-            store.data[self._key()] = body
-            store.cond.notify_all()
+        store.put(self._key(), body)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -133,6 +133,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_perf()
         if key == "memory":
             return self._do_memory()
+        if key == "shards":
+            return self._do_shards()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -140,16 +142,12 @@ class _KVHandler(BaseHTTPRequestHandler):
         deadline = time.monotonic() + timeout
         if self.headers.get("X-Prefix-Read"):
             return self._do_prefix_get(store, key, deadline)
-        with store.cond:
-            while key not in store.data:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    self.send_response(404)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                store.cond.wait(remaining)
-            body = store.data[key]
+        body = store.wait_key(key, deadline)
+        if body is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
         skey = self.server.secret_key  # type: ignore[attr-defined]
@@ -171,11 +169,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
         from ..utils import metrics as metrics_mod
 
-        store = self.server.store  # type: ignore[attr-defined]
         scope_prefix = metrics_mod.KV_SCOPE + "/"
-        with store.cond:
-            pushed = {k: v for k, v in store.data.items()
-                      if k.startswith(scope_prefix)}
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
         worker = []
         for k, v in sorted(pushed.items()):
             suffix = k[len(scope_prefix):]  # "rank3"
@@ -244,11 +239,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
         from ..utils import tracing as tracing_mod
 
-        store = self.server.store  # type: ignore[attr-defined]
         scope_prefix = tracing_mod.KV_SCOPE + "/"
-        with store.cond:
-            pushed = {k: v for k, v in store.data.items()
-                      if k.startswith(scope_prefix)}
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
         buffers = []
         local = tracing_mod.get_tracer()
         if local is not None:
@@ -280,11 +272,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
         from ..utils import diag as diag_mod
 
-        store = self.server.store  # type: ignore[attr-defined]
         scope_prefix = diag_mod.KV_SCOPE + "/"
-        with store.cond:
-            pushed = {k: v for k, v in store.data.items()
-                      if k.startswith(scope_prefix)}
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
         bundles = {}
         for k, v in sorted(pushed.items()):
             suffix = k[len(scope_prefix):]  # "rank1"
@@ -312,11 +301,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
         from ..utils import perfledger as perfledger_mod
 
-        store = self.server.store  # type: ignore[attr-defined]
         scope_prefix = perfledger_mod.KV_SCOPE + "/"
-        with store.cond:
-            pushed = {k: v for k, v in store.data.items()
-                      if k.startswith(scope_prefix)}
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
         entries = []
         for k, v in sorted(pushed.items()):
             suffix = k[len(scope_prefix):]  # "rank1"
@@ -354,11 +340,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
         from ..utils import memledger as memledger_mod
 
-        store = self.server.store  # type: ignore[attr-defined]
         scope_prefix = memledger_mod.KV_SCOPE + "/"
-        with store.cond:
-            pushed = {k: v for k, v in store.data.items()
-                      if k.startswith(scope_prefix)}
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
         entries = []
         for k, v in sorted(pushed.items()):
             suffix = k[len(scope_prefix):]  # "rank1"
@@ -384,6 +367,24 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _do_shards(self):
+        """``GET /shards``: the binary shard listeners' routing table —
+        a JSON list of ports, index-aligned with the scope-hash the
+        client computes (``crc32(scope) % len``). Empty when the store
+        runs unsharded. Auth-exempt like ``/clock``: ports are not
+        secrets, and the client needs the table before it can route its
+        first signed request. Same bare-path no-collision argument as
+        the other telemetry endpoints."""
+        import json
+
+        ports = getattr(self.server, "shard_ports", [])
+        body = json.dumps({"shards": list(ports)}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _do_prefix_get(self, store, prefix: str, deadline: float):
         """Bulk read: every key under ``prefix`` in one request, blocking
         until at least X-Min-Count keys exist (or the timeout passes —
@@ -397,16 +398,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         import json
 
         min_count = int(self.headers.get("X-Min-Count", "1"))
-        with store.cond:
-            while True:
-                matches = {k: v for k, v in store.data.items()
-                           if k.startswith(prefix)}
-                if len(matches) >= min_count:
-                    break
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                store.cond.wait(remaining)
+        matches = store.wait_prefix(prefix, min_count, deadline)
         body = json.dumps(
             {k[len(prefix):]: base64.b64encode(v).decode()
              for k, v in matches.items()}).encode()
@@ -422,23 +414,315 @@ class _KVHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         if not self._authorized():
             return self._reject()
-        store = self.server.store  # type: ignore[attr-defined]
+        # prefix sweeps span shards by nature (a GC of ``ctl/`` must
+        # reach every store no matter how scopes hashed), and the sweep
+        # is idempotent — apply it everywhere
+        prefix = self._key()
         exclude = self.headers.get("X-Exclude-Prefix")
-        with store.cond:
-            prefix = self._key()
-            for k in [k for k in store.data if k.startswith(prefix)]:
-                if exclude and k.startswith(exclude):
-                    continue  # live namespace: a GC sweep must not race it
-                del store.data[k]
+        for st in self.server.all_stores:  # type: ignore[attr-defined]
+            st.delete_prefix(prefix, exclude)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
 
 class _Store:
-    def __init__(self):
-        self.data: dict[str, bytes] = {}
-        self.cond = threading.Condition()
+    """One KV shard: a plain dict plus *targeted* wakeups.
+
+    The first cut parked every blocking read on one shared Condition and
+    PUT ``notify_all()``-ed the lot: with 1000 ranks parked on round
+    responses, every PUT cost 1000 wakeups and 1000 re-scans — a
+    thundering herd that burned a CPU doing nothing. Waiters now
+    register per exact key or per prefix, so a PUT touches exactly the
+    waiters its key can satisfy: a parked world costs one dict lookup
+    per PUT and wakes in microseconds. The Events are wake *hints* —
+    the waiting side re-checks the data under the lock, so a racing
+    DELETE degrades to a spurious wakeup, never a wrong answer, and the
+    404-on-deadline contract of the blocking GET is unchanged.
+    """
+
+    def __init__(self, waiter_gauge=None):
+        self.lock = threading.Lock()
+        self.data: dict[str, bytes] = {}  # guarded-by: lock
+        # key -> [Event, ...] parked exact-key readers
+        self._key_waiters: dict[str, list] = {}  # guarded-by: lock
+        # [prefix, still_missing_count, Event] parked prefix readers
+        self._prefix_waiters: list = []  # guarded-by: lock
+        # hvd_kv_waiters gauge, or None => the series never exists
+        # (zero-cost contract when scale-out features are off)
+        self._m_waiters = waiter_gauge
+
+    def put(self, key: str, value: bytes) -> None:
+        fire = []
+        with self.lock:
+            fresh = key not in self.data
+            self.data[key] = value
+            fire.extend(self._key_waiters.pop(key, ()))
+            if fresh:
+                for w in self._prefix_waiters:
+                    if key.startswith(w[0]):
+                        w[1] -= 1
+                        if w[1] <= 0:
+                            fire.append(w[2])
+        for ev in fire:
+            ev.set()
+
+    def wait_key(self, key: str, deadline: float) -> Optional[bytes]:
+        """Value of ``key``, blocking until it exists or ``deadline``
+        (time.monotonic) passes — then None (the handler's 404)."""
+        with self.lock:
+            v = self.data.get(key)
+            if v is not None:
+                return v
+            ev = threading.Event()
+            self._key_waiters.setdefault(key, []).append(ev)
+        g = self._m_waiters
+        if g is not None:
+            g.inc()
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                fired = remaining > 0 and ev.wait(remaining)
+                with self.lock:
+                    v = self.data.get(key)
+                    if v is not None or not fired:
+                        lst = self._key_waiters.get(key)
+                        if lst is not None:
+                            try:
+                                lst.remove(ev)
+                            except ValueError:
+                                pass  # PUT already popped the list
+                            if not lst:
+                                del self._key_waiters[key]
+                        return v
+                    # woken but the key vanished again (racing DELETE):
+                    # re-arm and keep waiting out the deadline
+                    ev.clear()
+                    self._key_waiters.setdefault(key, []).append(ev)
+        finally:
+            if g is not None:
+                g.dec()
+
+    def wait_prefix(self, prefix: str, min_count: int,
+                    deadline: float) -> dict:
+        """Every key under ``prefix`` once at least ``min_count`` exist,
+        or whatever is present at ``deadline`` — partial results are the
+        caller's stall-attribution signal. The registered waiter counts
+        *new* matching PUTs down instead of rescanning the store on
+        every write (the scan runs once per wake, not once per PUT)."""
+        while True:
+            with self.lock:
+                matches = {k: v for k, v in self.data.items()
+                           if k.startswith(prefix)}
+                if len(matches) >= min_count:
+                    return matches
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return matches
+                ev = threading.Event()
+                w = [prefix, min_count - len(matches), ev]
+                self._prefix_waiters.append(w)
+            g = self._m_waiters
+            if g is not None:
+                g.inc()
+            try:
+                ev.wait(remaining)
+            finally:
+                if g is not None:
+                    g.dec()
+                with self.lock:
+                    try:
+                        self._prefix_waiters.remove(w)
+                    except ValueError:
+                        pass
+
+    def delete_prefix(self, prefix: str,
+                      exclude: Optional[str] = None) -> None:
+        with self.lock:
+            for k in [k for k in self.data if k.startswith(prefix)]:
+                if exclude and k.startswith(exclude):
+                    continue  # live namespace: a GC sweep must not race it
+                del self.data[k]
+
+
+# -- binary shard protocol -------------------------------------------------
+#
+# The negotiation path is request-parse-bound at pod scale: every KV
+# exchange through BaseHTTPRequestHandler pays header readline parsing +
+# response formatting, ~100+ µs of pure Python per request, serialized
+# by the GIL when hundreds of ranks talk to one launcher process. Shard
+# listeners speak a length-prefixed binary framing instead (~an order of
+# magnitude less Python per exchange) while the primary HTTP server
+# stays up unchanged for bootstrap, telemetry scrapes, and unsharded
+# jobs. Same HMAC material as the HTTP path (runner/secret.py): requests
+# sign (verb, path, exclude, ts, mode, body); read responses sign
+# (path, payload).
+#
+#   request  := 0x4B verb:u8 len:u32 body
+#   body     := path:str16 ts:str16 digest:str16 exclude:str16
+#               timeout:f64 min_count:u32 value:bytes
+#   response := status:u8 len:u32 payload digest:str16
+#   status   := 0 ok | 1 not-found (the blocking-GET 404) | 3 forbidden
+#
+# PUTGET is the negotiation hot-path verb: store `path`=`value`, then
+# block on the key named by the `exclude` field (reused as the read
+# path — both are under the request digest) until it exists or
+# `timeout` passes. One exchange instead of two per member per round —
+# at pod scale the control plane is exchange-count-bound, not
+# byte-bound.
+
+BIN_MAGIC = 0x4B  # "K"
+_BV_PUT, _BV_GET, _BV_PREFIX, _BV_DELETE, _BV_PUTGET = 1, 2, 3, 4, 5
+_BIN_VERB_NAMES = {_BV_PUT: "BINPUT", _BV_GET: "BINGET",
+                   _BV_PREFIX: "BINPREFIX", _BV_DELETE: "BINDELETE",
+                   _BV_PUTGET: "BINPUTGET"}
+_BIN_MAX_FRAME = 64 << 20
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    parts = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionResetError("KV shard peer closed")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+def _pack_str16(s: bytes) -> bytes:
+    return struct.pack("<H", len(s)) + s
+
+
+class _ShardListener(threading.Thread):
+    """One binary-framed listener socket bound to one shard store.
+
+    Thread-per-connection like the HTTP side (clients keep per-thread
+    persistent sockets, so the thread count tracks live client threads,
+    not request rate); blocking reads park on the store's targeted
+    waiters exactly like the HTTP handler does."""
+
+    def __init__(self, store: _Store, secret_key: Optional[str]):
+        super().__init__(daemon=True, name="hvd-kv-shard")
+        self._store = store
+        self._secret_key = secret_key
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(1024)
+        self.port = self._sock.getsockname()[1]
+        self._stopped = False
+
+    def run(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed by stop()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="hvd-kv-shard-conn").start()
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve_conn(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                hdr = _recv_exact(conn, 6)
+                verb = hdr[1]
+                (blen,) = struct.unpack_from("<I", hdr, 2)
+                if hdr[0] != BIN_MAGIC or blen > _BIN_MAX_FRAME:
+                    return  # garbage on the wire: drop the conn
+                status, payload, path = self._handle(
+                    verb, _recv_exact(conn, blen))
+                dig = b""
+                if (self._secret_key and status == 0
+                        and verb in (_BV_GET, _BV_PREFIX, _BV_PUTGET)):
+                    dig = _secret.response_digest(
+                        self._secret_key, path, payload).encode()
+                conn.sendall(struct.pack("<BI", status, len(payload))
+                             + payload + _pack_str16(dig))
+        except (OSError, ConnectionResetError, struct.error):
+            pass  # peer closed / teardown: nothing to answer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, verb: int, body: bytes):
+        pos = 0
+
+        def s16():
+            nonlocal pos
+            (n,) = struct.unpack_from("<H", body, pos)
+            pos += 2 + n
+            return body[pos - n:pos]
+
+        path_b = s16()
+        ts = s16()
+        dig = s16()
+        excl = s16()
+        timeout, min_count = struct.unpack_from("<dI", body, pos)
+        pos += 12
+        value = body[pos:]
+        path = path_b.decode("utf-8", "replace")
+        if not self._authorized(verb, path, value, excl, ts, min_count,
+                                dig):
+            return 3, b"", path
+        if verb == _BV_PUT:
+            self._store.put(path, value)
+            return 0, b"", path
+        if verb == _BV_GET:
+            v = self._store.wait_key(path, time.monotonic() + timeout)
+            return (1, b"", path) if v is None else (0, v, path)
+        if verb == _BV_PUTGET:
+            # both keys hash to this shard (the client routes by scope
+            # and only combines same-scope pairs); the response digest
+            # binds the payload to the request path like a plain GET
+            self._store.put(path, value)
+            get_path = excl.decode("utf-8", "replace")
+            v = self._store.wait_key(get_path, time.monotonic() + timeout)
+            return (1, b"", path) if v is None else (0, v, path)
+        if verb == _BV_PREFIX:
+            matches = self._store.wait_prefix(
+                path, max(1, min_count), time.monotonic() + timeout)
+            out = bytearray()
+            for k in sorted(matches):
+                out += _pack_str16(k[len(path):].encode())
+                v = matches[k]
+                out += struct.pack("<I", len(v)) + v
+            return 0, bytes(out), path
+        if verb == _BV_DELETE:
+            self._store.delete_prefix(
+                path, excl.decode("utf-8", "replace") or None)
+            return 0, b"", path
+        return 3, b"", path  # unknown verb
+
+    def _authorized(self, verb, path, value, excl, ts, min_count,
+                    dig) -> bool:
+        key = self._secret_key
+        if not key:
+            return True
+        ts_s = ts.decode("ascii", "replace")
+        try:
+            skew = abs(time.time() - float(ts_s))
+        except ValueError:
+            return False
+        if skew > _secret.MAX_SKEW_SECONDS:
+            return False  # stale (or far-future) signed request: replay
+        want = _secret.request_digest(
+            key, _BIN_VERB_NAMES.get(verb, "?"), path, value,
+            excl.decode("utf-8", "replace"), ts=ts_s,
+            mode=f"bin:{min_count}")
+        import hmac as _hmac
+
+        return _hmac.compare_digest(want.encode(), dig)
 
 
 class _KVServer(ThreadingHTTPServer):
@@ -460,6 +744,18 @@ class _KVServer(ThreadingHTTPServer):
             return  # peer closed its keep-alive conn (job teardown)
         super().handle_error(request, client_address)
 
+    def scan_prefix(self, prefix: str) -> dict:
+        """Telemetry view across every shard store (pushed snapshots
+        hash wherever their scope lands; the merge endpoints must see
+        them all)."""
+        out: dict = {}
+        for st in self.all_stores:  # type: ignore[attr-defined]
+            with st.lock:
+                for k, v in st.data.items():
+                    if k.startswith(prefix):
+                        out[k] = v
+        return out
+
 
 class RendezvousServer:
     """Blocking-GET KV store over HTTP (reference RendezvousServer,
@@ -468,26 +764,65 @@ class RendezvousServer:
     ``secret_key=None`` (default) picks up the job secret from
     ``HOROVOD_SECRET_KEY`` when the launcher minted one; pass an explicit
     key to override. Without a key the store is open (standalone /
-    single-host test use)."""
+    single-host test use).
 
-    def __init__(self, port: int = 0, secret_key: Optional[str] = None):
+    ``shards`` (default: ``HOROVOD_KV_SHARDS``, 1) partitions the
+    keyspace across that many stores, each with its own binary-framed
+    listener socket (clients route by ``crc32(scope)``, discovered via
+    ``GET /shards``) — one launcher socket stops being the fleet's
+    serialization point at 1000+ ranks (docs/scaling.md). With 1 shard
+    the server is exactly the legacy single-store HTTP server and no
+    extra sockets or ``hvd_kv_waiters`` series exist."""
+
+    def __init__(self, port: int = 0, secret_key: Optional[str] = None,
+                 shards: Optional[int] = None):
+        from ..common import env as env_schema
+
+        if shards is None:
+            shards = env_schema.get_int(env_schema.HOROVOD_KV_SHARDS, 1)
+        shards = max(1, int(shards))
+        key = (secret_key if secret_key is not None
+               else _secret.env_secret())
+        gauge = None
+        if shards > 1 or env_schema.get_bool(
+                env_schema.HOROVOD_HIER_NEGOTIATION):
+            from ..utils import metrics as metrics_mod
+
+            gauge = metrics_mod.get_registry().gauge(
+                "hvd_kv_waiters",
+                "KV requests currently parked on a blocking read")
+        self._stores = [_Store(gauge) for _ in range(shards)]
         self._server = _KVServer(("0.0.0.0", port), _KVHandler)
-        self._server.store = _Store()  # type: ignore[attr-defined]
-        self._server.secret_key = (  # type: ignore[attr-defined]
-            secret_key if secret_key is not None else _secret.env_secret())
+        self._server.store = self._stores[0]  # type: ignore[attr-defined]
+        self._server.all_stores = self._stores  # type: ignore[attr-defined]
+        self._server.secret_key = key  # type: ignore[attr-defined]
+        # every store gets a binary listener (shard 0 included: a round
+        # scope that hashes to 0 must not be the one slow HTTP shard)
+        self._listeners = ([_ShardListener(st, key) for st in self._stores]
+                           if shards > 1 else [])
+        self._server.shard_ports = [  # type: ignore[attr-defined]
+            ln.port for ln in self._listeners]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._server.server_address[1]
 
+    @property
+    def shard_ports(self) -> list:
+        return [ln.port for ln in self._listeners]
+
     def start(self) -> int:
+        for ln in self._listeners:
+            ln.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="hvd-rendezvous")
         self._thread.start()
         return self.port
 
     def stop(self):
+        for ln in self._listeners:
+            ln.stop()
         self._server.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
@@ -517,12 +852,166 @@ class KVStoreClient:
 
     def __init__(self, addr: str, port: int,
                  secret_key: Optional[str] = None):
+        from ..common import env as env_schema
+
         self.addr = addr
         self.port = port
         self.base = f"http://{addr}:{port}"
         self._secret = (secret_key if secret_key is not None
                         else _secret.env_secret())
         self._local = threading.local()
+        # sharded routing: the env knob opts the client in, the server's
+        # /shards table is the truth (an unsharded server returns an
+        # empty table and the client stays on the HTTP path — the env
+        # can never split-brain the routing)
+        self._want_shards = env_schema.get_int(
+            env_schema.HOROVOD_KV_SHARDS, 1)
+        self._shard_ports: Optional[list] = None
+        # per-verb latency histograms + reconnect counter, created
+        # lazily on first use (same pattern as the retry-site counters);
+        # gated like hvd_kv_waiters so a legacy job (1 shard, hierarchy
+        # off) emits zero new hvd_* series
+        self._instrument = (self._want_shards > 1 or env_schema.get_bool(
+            env_schema.HOROVOD_HIER_NEGOTIATION))
+        self._m_lat: dict = {}
+        self._m_reconnects = None
+
+    def _observe(self, verb: str, t0: float):
+        if not self._instrument:
+            return
+        h = self._m_lat.get(verb)
+        if h is None:
+            from ..utils import metrics as metrics_mod
+
+            h = self._m_lat[verb] = metrics_mod.get_registry().histogram(
+                "hvd_kv_request_seconds",
+                "KV client request latency by verb "
+                "(retries and reconnects included)", verb=verb)
+        h.observe(time.monotonic() - t0)
+
+    def _note_reconnect(self):
+        if not self._instrument:
+            return
+        m = self._m_reconnects
+        if m is None:
+            from ..utils import metrics as metrics_mod
+
+            m = self._m_reconnects = metrics_mod.get_registry().counter(
+                "hvd_kv_reconnects_total",
+                "KV client connections dropped mid-exchange and redialed")
+        m.inc()
+
+    def _shard_port(self, scope: str) -> Optional[int]:
+        """Scope-hashed shard routing. crc32, never ``hash()`` — the
+        builtin is salted per process and every client in the job must
+        agree on where a scope lives. None routes to the primary HTTP
+        server (unsharded job, or the server reported no shards)."""
+        if self._want_shards <= 1:
+            return None
+        ports = self._shard_ports
+        if ports is None:
+            ports = self._fetch_shards()
+            self._shard_ports = ports
+        if not ports:
+            return None
+        return ports[zlib.crc32(scope.encode()) % len(ports)]
+
+    def _fetch_shards(self) -> list:
+        import json
+
+        def attempt():
+            status, _, body = self._attempt("GET", "shards", None, {},
+                                            10.0)
+            if status != 200:
+                raise HTTPError(f"{self.base}/shards", status,
+                                "shard table", None, None)
+            return list(json.loads(body).get("shards", []))
+
+        policy = _retry.RetryPolicy.from_env(max_attempts=3,
+                                             base_delay_s=0.05,
+                                             max_delay_s=1.0)
+        return _retry.Retrier("kv.get", policy).call(attempt)
+
+    def _bin_conn(self, port: int):
+        conns = getattr(self._local, "bins", None)
+        if conns is None:
+            conns = self._local.bins = {}
+        sock = conns.get(port)
+        if sock is None:
+            sock = socket.create_connection((self.addr, port),
+                                            timeout=10.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conns[port] = sock
+        return sock
+
+    def _bin_attempt(self, port: int, verb: int, path: str, value: bytes,
+                     excl: str, timeout: float, min_count: int):
+        sock = self._bin_conn(port)
+        try:
+            ts = f"{time.time():.6f}" if self._secret else ""
+            dig = b""
+            if self._secret:
+                dig = _secret.request_digest(
+                    self._secret, _BIN_VERB_NAMES[verb], path, value,
+                    excl, ts=ts, mode=f"bin:{min_count}").encode()
+            body = (_pack_str16(path.encode()) + _pack_str16(ts.encode())
+                    + _pack_str16(dig) + _pack_str16(excl.encode())
+                    + struct.pack("<dI", float(timeout), int(min_count))
+                    + value)
+            sock.settimeout(timeout + 10.0)
+            sock.sendall(struct.pack("<BBI", BIN_MAGIC, verb, len(body))
+                         + body)
+            hdr = _recv_exact(sock, 5)
+            (n,) = struct.unpack_from("<I", hdr, 1)
+            payload = _recv_exact(sock, n)
+            (dn,) = struct.unpack_from("<H", _recv_exact(sock, 2), 0)
+            rdig = (_recv_exact(sock, dn).decode("ascii", "replace")
+                    if dn else "")
+            return hdr[0], payload, rdig
+        except OSError:
+            # stale shard socket: drop it so the retry dials fresh
+            try:
+                sock.close()
+            except OSError:
+                pass
+            getattr(self._local, "bins", {}).pop(port, None)
+            self._note_reconnect()
+            raise
+
+    def _bin_request(self, port: int, verb: int, path: str,
+                     value: bytes = b"", excl: str = "",
+                     timeout: float = 30.0, min_count: int = 0,
+                     site: str = "") -> bytes:
+        policy = _retry.RetryPolicy.from_env(max_attempts=2,
+                                             base_delay_s=0.05,
+                                             max_delay_s=1.0)
+
+        def attempt():
+            _faults.fault_point(site)
+            return self._bin_attempt(port, verb, path, value, excl,
+                                     timeout, min_count)
+
+        status, payload, rdig = _retry.Retrier(site, policy).call(attempt)
+        what = f"{_BIN_VERB_NAMES[verb]} {path}"
+        if status == 3:
+            raise KVAuthError(
+                f"KV shard refused {what}: HMAC digest rejected — either "
+                "the secret key differs or this host's clock is outside "
+                "the replay window (verify NTP)")
+        if status == 1:
+            # same exception surface as the HTTP blocking-GET deadline:
+            # callers distinguish the timeout by HTTPError.code == 404
+            raise HTTPError(f"{self.base}/{path}", 404, what, None, None)
+        if status != 0:
+            raise HTTPError(f"{self.base}/{path}", 500, what, None, None)
+        if (self._secret and verb in (_BV_GET, _BV_PREFIX, _BV_PUTGET)
+                and not _secret.check_digest(
+                    self._secret, rdig, b"RESP", path.encode(), payload)):
+            raise KVAuthError(
+                f"{what}: response digest missing or invalid — the value "
+                "was tampered with in transit or the shard does not hold "
+                "the job secret")
+        return payload
 
     def _attempt(self, method: str, path: str, body: Optional[bytes],
                  headers: dict, timeout: float):
@@ -559,6 +1048,7 @@ class KVStoreClient:
             except Exception:
                 pass
             self._local.conn = None
+            self._note_reconnect()
             raise
 
     def _request(self, method: str, path: str, body: Optional[bytes],
@@ -607,30 +1097,70 @@ class KVStoreClient:
         raise HTTPError(f"{self.base}/{path}", status, what, None, None)
 
     def put(self, scope: str, key: str, value: bytes):
-        path = f"{scope}/{key}"
-        # torn-write chaos hook BEFORE signing: the mangled payload is
-        # stored "successfully" with a valid digest, exactly the artifact
-        # a writer crash mid-value leaves for readers to tolerate
-        value = _faults.corrupt("kv.put", value)
-        status, _, _ = self._request(
-            "PUT", path, value, self._headers("PUT", path, value), 30.0)
-        self._check_status(status, path, f"PUT {path}")
+        t0 = time.monotonic()
+        try:
+            path = f"{scope}/{key}"
+            # torn-write chaos hook BEFORE signing: the mangled payload is
+            # stored "successfully" with a valid digest, exactly the artifact
+            # a writer crash mid-value leaves for readers to tolerate
+            value = _faults.corrupt("kv.put", value)
+            port = self._shard_port(scope)
+            if port is not None:
+                self._bin_request(port, _BV_PUT, path, value=value,
+                                  site="kv.put")
+                return
+            status, _, _ = self._request(
+                "PUT", path, value, self._headers("PUT", path, value), 30.0)
+            self._check_status(status, path, f"PUT {path}")
+        finally:
+            self._observe("put", t0)
 
     def get(self, scope: str, key: str, timeout: float = 30.0) -> bytes:
-        path = f"{scope}/{key}"
-        headers = {"X-Timeout": str(timeout)}
-        headers.update(self._headers("GET", path))
-        status, rhdrs, body = self._request("GET", path, None, headers,
-                                            timeout + 10)
-        self._check_status(status, path, f"GET {path}")
-        if self._secret and not _secret.check_digest(
-                self._secret, rhdrs.get(_secret.DIGEST_HEADER),
-                b"RESP", path.encode(), body):
-            raise KVAuthError(
-                f"GET {path}: response digest missing or invalid — the "
-                "value was tampered with in transit or the store does not "
-                "hold the job secret")
-        return body
+        t0 = time.monotonic()
+        try:
+            path = f"{scope}/{key}"
+            port = self._shard_port(scope)
+            if port is not None:
+                return self._bin_request(port, _BV_GET, path,
+                                         timeout=timeout, site="kv.get")
+            headers = {"X-Timeout": str(timeout)}
+            headers.update(self._headers("GET", path))
+            status, rhdrs, body = self._request("GET", path, None, headers,
+                                                timeout + 10)
+            self._check_status(status, path, f"GET {path}")
+            if self._secret and not _secret.check_digest(
+                    self._secret, rhdrs.get(_secret.DIGEST_HEADER),
+                    b"RESP", path.encode(), body):
+                raise KVAuthError(
+                    f"GET {path}: response digest missing or invalid — the "
+                    "value was tampered with in transit or the store does "
+                    "not hold the job secret")
+            return body
+        finally:
+            self._observe("get", t0)
+
+    def put_get(self, scope: str, put_key: str, value: bytes,
+                get_key: str, timeout: float = 30.0) -> bytes:
+        """Combined submit-and-wait: store ``scope/put_key`` then block
+        on ``scope/get_key`` until it exists (or raise the blocking-GET
+        404 at the deadline) — ONE wire exchange instead of two. Both
+        keys share the scope, so they route to the same shard; without
+        shard routing this degrades to sequential put()+get() over
+        HTTP. The negotiation member path rides this: at pod scale the
+        control plane is bound by exchange count, not payload bytes."""
+        port = self._shard_port(scope)
+        if port is None:
+            self.put(scope, put_key, value)
+            return self.get(scope, get_key, timeout=timeout)
+        t0 = time.monotonic()
+        try:
+            value = _faults.corrupt("kv.put", value)
+            return self._bin_request(
+                port, _BV_PUTGET, f"{scope}/{put_key}", value=value,
+                excl=f"{scope}/{get_key}", timeout=timeout,
+                site="kv.get")
+        finally:
+            self._observe("put_get", t0)
 
     def get_prefix(self, scope: str, prefix: str = "", min_count: int = 1,
                    timeout: float = 30.0) -> dict:
@@ -642,34 +1172,83 @@ class KVStoreClient:
         import base64
         import json
 
-        path = f"{scope}/{prefix}"
-        mode = f"prefix:{min_count}"
-        headers = {"X-Prefix-Read": "1", "X-Min-Count": str(min_count),
-                   "X-Timeout": str(timeout)}
-        headers.update(self._headers("GET", path, mode=mode))
-        status, rhdrs, body = self._request("GET", path, None, headers,
-                                            timeout + 10, site="kv.wait")
-        self._check_status(status, path, f"GET(prefix) {path}")
-        if self._secret and not _secret.check_digest(
-                self._secret, rhdrs.get(_secret.DIGEST_HEADER),
-                b"RESP", path.encode(), body):
-            raise KVAuthError(
-                f"GET(prefix) {path}: response digest missing or invalid")
-        return {k: base64.b64decode(v)
-                for k, v in json.loads(body).items()}
+        t0 = time.monotonic()
+        try:
+            path = f"{scope}/{prefix}"
+            port = self._shard_port(scope)
+            if port is not None:
+                payload = self._bin_request(
+                    port, _BV_PREFIX, path, timeout=timeout,
+                    min_count=min_count, site="kv.wait")
+                out = {}
+                pos = 0
+                while pos < len(payload):
+                    (kl,) = struct.unpack_from("<H", payload, pos)
+                    pos += 2
+                    k = payload[pos:pos + kl].decode("utf-8", "replace")
+                    pos += kl
+                    (vl,) = struct.unpack_from("<I", payload, pos)
+                    pos += 4
+                    out[k] = payload[pos:pos + vl]
+                    pos += vl
+                return out
+            mode = f"prefix:{min_count}"
+            headers = {"X-Prefix-Read": "1", "X-Min-Count": str(min_count),
+                       "X-Timeout": str(timeout)}
+            headers.update(self._headers("GET", path, mode=mode))
+            status, rhdrs, body = self._request("GET", path, None, headers,
+                                                timeout + 10, site="kv.wait")
+            self._check_status(status, path, f"GET(prefix) {path}")
+            if self._secret and not _secret.check_digest(
+                    self._secret, rhdrs.get(_secret.DIGEST_HEADER),
+                    b"RESP", path.encode(), body):
+                raise KVAuthError(
+                    f"GET(prefix) {path}: response digest missing or "
+                    "invalid")
+            return {k: base64.b64decode(v)
+                    for k, v in json.loads(body).items()}
+        finally:
+            self._observe("wait", t0)
 
     def delete_scope(self, scope: str):
-        path = f"{scope}/"
-        status, _, _ = self._request(
-            "DELETE", path, None, self._headers("DELETE", path), 30.0)
-        self._check_status(status, path, f"DELETE {path}")
+        t0 = time.monotonic()
+        try:
+            path = f"{scope}/"
+            port = self._shard_port(scope)
+            if port is not None:
+                # a scope's keys all hash to one shard: routed, not swept
+                self._bin_request(port, _BV_DELETE, path,
+                                  site="kv.delete")
+                return
+            status, _, _ = self._request(
+                "DELETE", path, None, self._headers("DELETE", path), 30.0)
+            self._check_status(status, path, f"DELETE {path}")
+        finally:
+            self._observe("delete", t0)
 
     def delete_prefix(self, prefix: str, exclude: Optional[str] = None):
         """Delete every key under ``prefix`` except those under
         ``exclude`` (stale-generation GC that must not race the live
         namespace's fresh keys)."""
-        headers = self._headers("DELETE", prefix, exclude=exclude or "")
-        if exclude:
-            headers["X-Exclude-Prefix"] = exclude
-        status, _, _ = self._request("DELETE", prefix, None, headers, 30.0)
-        self._check_status(status, prefix, f"DELETE {prefix}")
+        t0 = time.monotonic()
+        try:
+            if self._want_shards > 1:
+                ports = self._shard_ports
+                if ports is None:
+                    ports = self._shard_ports = self._fetch_shards()
+                if ports:
+                    # a bare prefix spans scopes, so the sweep must reach
+                    # every shard (idempotent: replays are harmless)
+                    for port in ports:
+                        self._bin_request(port, _BV_DELETE, prefix,
+                                          excl=exclude or "",
+                                          site="kv.delete")
+                    return
+            headers = self._headers("DELETE", prefix, exclude=exclude or "")
+            if exclude:
+                headers["X-Exclude-Prefix"] = exclude
+            status, _, _ = self._request("DELETE", prefix, None, headers,
+                                         30.0)
+            self._check_status(status, prefix, f"DELETE {prefix}")
+        finally:
+            self._observe("delete", t0)

@@ -54,6 +54,7 @@ HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
 SITES = (
     "kv.get", "kv.put", "kv.wait", "kv.delete",
     "controller.poll", "controller.submit",
+    "leader.merge",
     "elastic.spawn", "elastic.heartbeat",
     "metrics.push",
 )

@@ -51,6 +51,7 @@ CATEGORIES = (
     ("quant_fallback", "tensor kept off the quantized wire"),
     ("slo_breach", "declared SLO budget crossed its bound"),
     ("compile", "XLA program compiled for a cached plan"),
+    ("leader_round", "node-leader negotiation round merged or fell back"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

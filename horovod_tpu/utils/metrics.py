@@ -268,6 +268,42 @@ class MetricsRegistry:
                     out[n] += m._value
         return out
 
+    def histogram_quantile(self, name: str, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile over a histogram family, merged
+        across label sets (the PromQL ``histogram_quantile`` estimate:
+        linear within the winning bucket, lower bound 0, upper bound the
+        last finite edge). None when the family has no observations —
+        callers (perf ledger SLO fields) skip absent series instead of
+        reporting a fake 0."""
+        with self._lock:
+            hists = [m for (n, _), m in self._metrics.items()
+                     if n == name and isinstance(m, Histogram)]
+            if not hists:
+                return None
+            bounds = hists[0].bounds
+            counts = [0] * (len(bounds) + 1)
+            for m in hists:
+                if m.bounds != bounds:
+                    continue  # mixed bucket layouts merge meaninglessly
+                for i, c in enumerate(m._counts):
+                    counts[i] += c
+        total = sum(counts)
+        if total == 0:
+            return None
+        rank = max(0.0, min(1.0, float(q))) * total
+        acc = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if acc + c >= rank:
+                if i >= len(bounds):
+                    return bounds[-1] if bounds else 0.0
+                lo = bounds[i - 1] if i > 0 else 0.0
+                frac = (rank - acc) / c
+                return lo + (bounds[i] - lo) * frac
+            acc += c
+        return bounds[-1] if bounds else 0.0
+
     def render_prometheus(self) -> str:
         return render_snapshots([({}, self.snapshot())])
 

@@ -304,6 +304,17 @@ class PerfLedger:
             "allreduce_gbps": (sum_wire / sum_exec / 1e9)
             if sum_exec > 0 else 0.0,
         })
+        # KV control-plane latency (hvd_kv_request_seconds exists only
+        # with sharding/hierarchy on): lets SLO budgets like
+        # kv_request_p95_ms<=50 catch a degrading rendezvous store. The
+        # histogram is cumulative-process, not windowed — good enough
+        # for a breach gate, and absent series add no field at all.
+        from . import metrics as metrics_mod
+
+        kv_p95 = metrics_mod.get_registry().histogram_quantile(
+            "hvd_kv_request_seconds", 0.95)
+        if kv_p95 is not None:
+            out["kv_request_p95_ms"] = kv_p95 * 1e3
         return {k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in out.items()}
 

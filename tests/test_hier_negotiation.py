@@ -1,0 +1,245 @@
+"""Hierarchical negotiation end-to-end (ops/controller.py,
+docs/scaling.md): the round-0 version handshake into binary wire v2,
+leader aggregation over a sharded KV, the mixed-world v1 degradation,
+chaos-killed leaders falling back flat without desyncing a round, and
+the flag-off contract — byte-identical v1 wire, zero new hvd_* series.
+
+Worlds are in-process: N KVControllers on N threads against one real
+RendezvousServer (the benchmarks/controller_scaling.py harness shape),
+which exercises the full wire protocol with thread-level concurrency."""
+
+import json
+import threading
+
+import pytest
+
+from horovod_tpu.ops.controller import KVController
+from horovod_tpu.runner.http_server import KVStoreClient, RendezvousServer
+from horovod_tpu.utils import faults, flightrec, metrics, tracing
+
+REG = metrics.get_registry()
+
+SIG = ["allreduce", "float32", [1024], 0, -1, 1.0, 1.0, "global", "host"]
+SIG2 = ["allgather", "int32", [8], 2, None, 1.0, 1.0, "global", "host"]
+
+#: the scale-out metric series that must NOT exist in a flag-off run
+GATED_SERIES = ("hvd_kv_waiters", "hvd_kv_request_seconds",
+                "hvd_kv_reconnects_total", "hvd_negotiation_fanin")
+
+
+def _world(nranks, schedule, *, shards=1, group_size=4, fallback_s=5.0,
+           hier=True, legacy_ranks=(), client_cls=KVStoreClient,
+           delays=None, timeout_s=120.0):
+    """Run ``nranks`` controllers through ``schedule`` (a list of pending
+    dicts, every rank submits the same; ``delays[(round, rank)]`` sleeps
+    that rank before its submit — a deterministic straggler). Returns
+    (controllers, clients, per-rank result lists) or raises on any
+    wedged/failed rank."""
+    import time
+
+    srv = RendezvousServer(shards=shards)
+    port = srv.start()
+    ctls = [None] * nranks
+    clis = [None] * nranks
+    results = [[] for _ in range(nranks)]
+    errs = []
+
+    def run(rank):
+        ctl = None
+        try:
+            cli = clis[rank] = client_cls("127.0.0.1", port)
+            ctl = ctls[rank] = KVController(
+                cli, rank, nranks, poll_timeout=timeout_s,
+                hier=(hier and rank not in legacy_ranks),
+                hier_group_size=group_size, hier_fallback_s=fallback_s)
+            for i, pending in enumerate(schedule):
+                if delays and (i, rank) in delays:
+                    time.sleep(delays[(i, rank)])
+                resp = ctl.negotiate(dict(pending))
+                results[rank].append(
+                    (sorted(resp["ready"]), dict(resp["errors"]),
+                     resp.get("strag")))
+        except Exception as e:
+            errs.append((rank, repr(e)))
+        finally:
+            if ctl is not None:
+                try:
+                    ctl.stop()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True,
+                                name=f"world-rank{r}")
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    hung = [t.name for t in threads if t.is_alive()]
+    srv.stop()
+    assert not hung, f"ranks wedged: {hung}"
+    assert not errs, f"ranks failed: {errs}"
+    return ctls, clis, results
+
+
+def _assert_agreed(results, schedule):
+    """Every rank saw every round's full ready set, error-free."""
+    for rank_res in results:
+        assert len(rank_res) == len(schedule)
+        for (ready, errors, _), pending in zip(rank_res, schedule):
+            assert ready == sorted(pending), (ready, pending)
+            assert errors == {}
+
+
+@pytest.fixture
+def hier_env(monkeypatch):
+    """Client-side shard routing opt-in for sharded worlds (the server's
+    /shards table remains the authority)."""
+
+    def _arm(shards):
+        monkeypatch.setenv("HOROVOD_KV_SHARDS", str(shards))
+
+    return _arm
+
+
+# --- happy path ------------------------------------------------------------
+
+def test_sharded_hier_world_switches_to_v2(hier_env):
+    hier_env(2)
+    schedule = [
+        {"warm": SIG},                                  # v1 handshake round
+        {f"t0_{j}": SIG for j in range(4)},             # binary from here
+        {f"t1_{j}": (SIG if j % 2 else SIG2) for j in range(4)},
+        {},                                             # idle round
+        {"steady": SIG}, {"steady": SIG},               # group-channel marker
+    ]
+    ctls, _, results = _world(12, schedule, shards=2, group_size=4)
+    _assert_agreed(results, schedule)
+    assert all(c.wire_format == "v2" for c in ctls)
+    # steady state rides SAME_AS_LAST on the group channel too
+    assert sum(c.fast_rounds for c in ctls) > 0
+    # the coordinator merged one aggregate per group: fan-in is N/k
+    assert REG.gauge("hvd_negotiation_fanin").value == 3
+
+
+def test_unsharded_hier_world_degrades_put_get_to_http():
+    # no KV shards: members' combined submit-and-wait becomes a
+    # sequential put()+get() over HTTP, everything else unchanged
+    schedule = [{"warm": SIG}, {f"t{j}": SIG for j in range(3)},
+                {"steady": SIG}, {"steady": SIG}]
+    ctls, _, results = _world(8, schedule, shards=1, group_size=4)
+    _assert_agreed(results, schedule)
+    assert all(c.wire_format == "v2" for c in ctls)
+
+
+def test_mixed_world_stays_v1_forever():
+    # one legacy rank never advertises wv=2: the coordinator must not
+    # confirm, and every rank keeps speaking flat v1 JSON — no flag day
+    schedule = [{"warm": SIG}, {f"t{j}": SIG for j in range(3)},
+                {"after": SIG}]
+    ctls, _, results = _world(6, schedule, group_size=4, legacy_ranks=(3,))
+    _assert_agreed(results, schedule)
+    assert all(c.wire_format == "v1" for c in ctls)
+
+
+# --- chaos: leader failure -------------------------------------------------
+
+@pytest.fixture
+def chaos(monkeypatch):
+    """Arm a fault spec + the flight recorder + tracing for one test."""
+
+    def _arm(spec):
+        monkeypatch.setenv("HOROVOD_FAULT_SPEC", spec)
+        monkeypatch.setenv("HOROVOD_FLIGHTREC", "1")
+        monkeypatch.setenv("HOROVOD_TRACE", "1")
+        faults.reset()
+        flightrec.reset_recorder()
+        flightrec.init_recorder(0)
+        tracing.reset_tracer()
+        tracing.init_tracer(0)
+
+    yield _arm
+    monkeypatch.delenv("HOROVOD_FAULT_SPEC", raising=False)
+    faults.reset()
+    flightrec.reset_recorder()
+    tracing.reset_tracer()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("spec", ["leader.merge:drop#2",
+                                  "leader.merge:error#1"])
+def test_leader_death_falls_back_flat_without_desync(chaos, spec):
+    chaos(spec)
+    schedule = [{"warm": SIG},
+                {f"t{j}": SIG for j in range(3)},   # leader.merge faults here
+                {"after0": SIG}, {"after1": SIG}]   # world keeps negotiating
+    # rank 7 drags its feet in the post-fault round: attribution must
+    # still name it even though its group is flat-backed-off by then
+    ctls, _, results = _world(8, schedule, group_size=4, fallback_s=0.5,
+                              delays={(2, 7): 0.4})
+    # the faulted round still converged on the full ready set — the
+    # leader resubmitted flat and its members re-submitted flat on their
+    # own fan-down deadline, so no tensor was lost and no rank desynced
+    _assert_agreed(results, schedule)
+    assert all(not c.broken for c in ctls)
+    rec = flightrec.get_recorder()
+    falls = [e for e in rec.events()
+             if e["cat"] == "leader_round" and e["kv"].get("fallback")]
+    assert falls, "leader fallback left no flight-recorder breadcrumb"
+    # straggler attribution survived the topology change: every rank's
+    # round-2 response blames rank 7 for the delayed tensor
+    for rank_res in results:
+        strag = rank_res[2][2]
+        assert strag and strag["after0"][0] == 7, strag
+        assert strag["after0"][1] >= 0.2
+    # and the tracer holds no leaked open spans after the chaos world
+    assert tracing.get_tracer().open_spans() == 0
+
+
+# --- flag off: the byte-identical contract ---------------------------------
+
+class _RecordingClient(KVStoreClient):
+    """Captures every negotiation submission this rank puts."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.submissions = []
+
+    def put(self, scope, key, value):
+        if key.startswith("ready/"):
+            self.submissions.append(bytes(value))
+        super().put(scope, key, value)
+
+
+def test_flag_off_wire_byte_identical_and_zero_new_series(monkeypatch):
+    monkeypatch.delenv("HOROVOD_HIER_NEGOTIATION", raising=False)
+    monkeypatch.delenv("HOROVOD_KV_SHARDS", raising=False)
+
+    def names(snap):
+        return {m["name"] for group in ("counters", "gauges", "histograms")
+                for m in snap[group]}
+
+    before = names(REG.snapshot())
+    schedule = [{"warm": SIG}, {"a": SIG, "b": SIG2},
+                {"a": SIG, "b": SIG2}]  # identical resubmission -> marker
+    ctls, clis, results = _world(2, schedule, hier=False,
+                                 client_cls=_RecordingClient)
+    _assert_agreed(results, schedule)
+    assert all(c.wire_format == "v1" for c in ctls)
+
+    markers, payloads = [], []
+    for cli in clis:
+        for w in cli.submissions:
+            (markers if w[:1] == b"=" else payloads).append(w)
+    # steady state: the identical round collapsed to the 1-byte marker
+    assert len(markers) == 2 and all(m == b"=" for m in markers)
+    # full payloads are exactly the legacy JSON shape — no version
+    # advert, no binary frames, nothing a pre-scale-out peer would choke
+    # on (the regression the handshake design exists to prevent)
+    assert len(payloads) == 4
+    for w in payloads:
+        msg = json.loads(w)
+        assert set(msg) == {"e", "j", "sd"}, msg
+    # and the scale-out series were never created by a flag-off run
+    created = names(REG.snapshot()) - before
+    assert not created.intersection(GATED_SERIES), created

@@ -1,0 +1,201 @@
+"""Sharded rendezvous KV (runner/http_server.py, docs/scaling.md):
+crc32 scope routing against the server's /shards authority table, the
+binary listeners (put/get/prefix/delete + the combined PUT_GET
+submit-and-wait verb), HMAC signing on the binary path, condition-based
+blocking reads (waiter gauge, no busy-wait), and the single-shard
+legacy degradation."""
+
+import threading
+import time
+import zlib
+from urllib.error import HTTPError
+
+import pytest
+
+from horovod_tpu.runner.http_server import (KVAuthError, KVStoreClient,
+                                            RendezvousServer)
+from horovod_tpu.utils import metrics
+
+REG = metrics.get_registry()
+
+
+@pytest.fixture
+def sharded(monkeypatch):
+    """A 4-shard server + a routing-enabled client (env opts the client
+    in; the server's /shards table stays the authority)."""
+    monkeypatch.setenv("HOROVOD_KV_SHARDS", "4")
+    srv = RendezvousServer(shards=4)
+    port = srv.start()
+    cli = KVStoreClient("127.0.0.1", port)
+    yield srv, cli
+    srv.stop()
+
+
+# --- routing ---------------------------------------------------------------
+
+def test_unsharded_server_cannot_be_split_brained(monkeypatch):
+    # env says 4 shards but the server is legacy: the empty /shards
+    # table wins and the client stays on the HTTP path
+    srv = RendezvousServer()  # shards resolved before the env is set
+    port = srv.start()
+    monkeypatch.setenv("HOROVOD_KV_SHARDS", "4")
+    try:
+        cli = KVStoreClient("127.0.0.1", port)
+        assert cli._shard_port("ctl/e0g0/r0") is None
+        cli.put("s", "k", b"v")
+        assert cli.get("s", "k") == b"v"
+    finally:
+        srv.stop()
+
+
+def test_scope_routing_is_crc32_deterministic(sharded):
+    srv, cli = sharded
+    ports = srv.shard_ports
+    assert len(ports) == 4 and len(set(ports)) == 4
+    other = KVStoreClient("127.0.0.1", srv.port)
+    for scope in (f"ctl/e0g0/r{i}" for i in range(32)):
+        want = ports[zlib.crc32(scope.encode()) % 4]
+        assert cli._shard_port(scope) == want
+        # every client in the job agrees on where a scope lives
+        assert other._shard_port(scope) == want
+
+
+# --- binary verbs ----------------------------------------------------------
+
+def test_put_get_roundtrip_across_all_shards(sharded):
+    _, cli = sharded
+    hit = set()
+    for i in range(32):
+        scope = f"round/{i}"
+        hit.add(cli._shard_port(scope))
+        cli.put(scope, "k", bytes([i]) * 3)
+        assert cli.get(scope, "k") == bytes([i]) * 3
+    assert len(hit) == 4  # the sweep exercised every listener
+
+
+def test_blocking_get_404_at_deadline(sharded):
+    _, cli = sharded
+    with pytest.raises(HTTPError) as ei:
+        cli.get("never", "k", timeout=0.2)
+    assert ei.value.code == 404
+
+
+def test_put_get_combined_verb_waits_then_returns(sharded):
+    _, cli = sharded
+    scope, out = "ctl/e0g0/g1", {}
+
+    def member():
+        out["resp"] = cli.put_get(scope, "ready/3", b"submission",
+                                  "resp", timeout=10.0)
+
+    t = threading.Thread(target=member, daemon=True)
+    t.start()
+    # the PUT half lands immediately even while the GET half is parked
+    assert cli.get(scope, "ready/3", timeout=5.0) == b"submission"
+    cli.put(scope, "resp", b"fan-down")
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert out["resp"] == b"fan-down"
+
+
+def test_put_get_404_deadline_still_stores_the_put(sharded):
+    _, cli = sharded
+    with pytest.raises(HTTPError) as ei:
+        cli.put_get("lonely", "ready/0", b"w", "resp", timeout=0.2)
+    assert ei.value.code == 404
+    assert cli.get("lonely", "ready/0") == b"w"
+
+
+def test_put_get_degrades_to_sequential_http_when_unsharded():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        cli = KVStoreClient("127.0.0.1", port)
+        cli.put("s", "resp", b"already-there")
+        assert cli.put_get("s", "ready/0", b"w", "resp") == b"already-there"
+        assert cli.get("s", "ready/0") == b"w"
+    finally:
+        srv.stop()
+
+
+def test_get_prefix_min_count_blocks_until_covered(sharded):
+    _, cli = sharded
+    scope = "ctl/e0g0/r7"
+
+    def writers():
+        for i in range(3):
+            time.sleep(0.05)
+            cli.put(scope, f"ready/{i}", b"x%d" % i)
+
+    threading.Thread(target=writers, daemon=True).start()
+    got = cli.get_prefix(scope, "ready/", min_count=3, timeout=10.0)
+    assert got == {"0": b"x0", "1": b"x1", "2": b"x2"}
+
+
+def test_delete_prefix_sweeps_every_shard_with_exclude(sharded):
+    _, cli = sharded
+    # scopes scatter across shards; the GC sweep must reach all of them
+    for i in range(16):
+        cli.put(f"gen0/{i}", "k", b"stale")
+        cli.put(f"gen1/{i}", "k", b"live")
+    cli.delete_prefix("gen", exclude="gen1/")
+    for i in range(16):
+        with pytest.raises(HTTPError):
+            cli.get(f"gen0/{i}", "k", timeout=0.05)
+        assert cli.get(f"gen1/{i}", "k") == b"live"
+
+
+# --- auth ------------------------------------------------------------------
+
+def test_binary_path_rejects_wrong_secret(monkeypatch):
+    monkeypatch.setenv("HOROVOD_KV_SHARDS", "2")
+    srv = RendezvousServer(shards=2, secret_key="job-secret")
+    port = srv.start()
+    try:
+        good = KVStoreClient("127.0.0.1", port, secret_key="job-secret")
+        good.put("s", "k", b"v")
+        assert good.get("s", "k") == b"v"
+        bad = KVStoreClient("127.0.0.1", port, secret_key="wrong")
+        with pytest.raises(KVAuthError):
+            bad.put("s", "k", b"poison")
+        assert good.get("s", "k") == b"v"  # the round was not poisoned
+    finally:
+        srv.stop()
+
+
+# --- instrumentation -------------------------------------------------------
+
+def test_waiter_gauge_tracks_parked_readers(sharded):
+    _, cli = sharded
+    gauge = REG.gauge("hvd_kv_waiters",
+                      "KV requests currently parked on a blocking read")
+    base = gauge.value
+
+    def reader():
+        cli.get("gauged", "k", timeout=10.0)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while gauge.value <= base and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert gauge.value == base + 1  # parked, not polling
+    cli.put("gauged", "k", b"v")
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert gauge.value == base
+
+
+def test_request_histogram_labels_cover_the_verbs(sharded):
+    _, cli = sharded
+    cli.put("h", "k", b"v")
+    cli.get("h", "k")
+    cli.put_get("h", "k2", b"v2", "k")
+    cli.get_prefix("h", "k", min_count=1, timeout=5.0)
+    cli.delete_scope("h")
+    snap = REG.snapshot()
+    seen = {tuple(sorted(h["labels"].items()))
+            for h in snap["histograms"]
+            if h["name"] == "hvd_kv_request_seconds"}
+    for verb in ("put", "get", "put_get", "wait", "delete"):
+        assert (("verb", verb),) in seen, (verb, seen)

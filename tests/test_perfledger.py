@@ -426,11 +426,7 @@ def test_benchguard_cli_on_banked_trajectory():
     assert verdict["history_comparable"] >= 3  # r02/r03 banked no parse
 
 
-@pytest.mark.slow
-def test_controller_scaling_budget_64_simulated_ranks(capsys):
-    """ROADMAP item-3 gate: negotiation p95 over a 64-rank simulated pod
-    (threads against one real HTTP store) stays within the static
-    budget, asserted through tools.benchguard's compare engine."""
+def _load_controller_scaling():
     import importlib.util as ilu
 
     spec = ilu.spec_from_file_location(
@@ -438,12 +434,41 @@ def test_controller_scaling_budget_64_simulated_ranks(capsys):
         os.path.join(REPO, "benchmarks", "controller_scaling.py"))
     mod = ilu.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_controller_scaling_budget_64_simulated_ranks(capsys):
+    """ROADMAP item-3 gate: negotiation p95 over a 64-rank simulated pod
+    (threads against one real HTTP store) stays within the static
+    budget, asserted through tools.benchguard's compare engine."""
+    mod = _load_controller_scaling()
     rc = mod.budget_main(["--ranks", "64", "--rounds", "15", "--json"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0, out
-    assert out["result"]["extras"]["ranks"] == 64
+    assert out["result"]["extras"]["flat"]["ranks"] == 64
     assert out["verdict"]["status"] == "ok"
     assert out["result"]["value"] <= 500.0
+
+
+@pytest.mark.slow
+def test_controller_scaling_gate_256_simulated_ranks(capsys):
+    """The scale-out acceptance gate (docs/scaling.md): at 256 simulated
+    ranks the hierarchical+binary leg must halve negotiation p95
+    (hier_speedup >= 2) and cut wire bytes/rank/round >= 3x, with the
+    flat leg inside its absolute p95 budget — all three asserted by
+    tools.benchguard against benchmarks/controller_budgets.json."""
+    mod = _load_controller_scaling()
+    rc = mod.budget_main(["--ranks", "256", "--rounds", "30",
+                          "--repeat", "2", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["verdict"]["status"] == "ok"
+    extras = out["result"]["extras"]
+    assert extras["hier"]["format"] == "v2"
+    assert extras["flat"]["format"] == "v1"
+    assert extras["hier_speedup"] >= 2.0, extras
+    assert extras["bytes_reduction"] >= 3.0, extras
 
 
 # ---------------------------------------------------------------------------
