@@ -467,7 +467,7 @@ def main():
     # flag is off (the zero-new-series contract's bench-side mirror).
     from horovod_tpu.common import context as _context_mod
 
-    _ctl = getattr(getattr(_context_mod.get_context(), "runtime", None),
+    _ctl = getattr(getattr(_context_mod.context(), "runtime", None),
                    "controller", None)
     extras["negotiation_format"] = (
         _ctl.wire_format if _ctl is not None else None)
@@ -478,6 +478,18 @@ def main():
         if _ctl is not None and _ctl_rounds else None)
     extras["controller_round_p95_ms"] = pstats.get("negotiate_p95_ms") \
         if _ctl is not None else None
+    # Joint autotuner state (docs/autotune.md). None-when-off convention:
+    # with HOROVOD_AUTOTUNE off the autotuner object never exists, so all
+    # three fields read None — the driver's trend tooling can tell
+    # "tuning off" from "tuned zero rounds".
+    _at = getattr(_context_mod.context(), "autotuner", None)
+    extras["autotune_rounds"] = (
+        int(_reg.counter_value("hvd_autotune_rounds_total"))
+        if _at is not None else None)
+    extras["autotune_best_score"] = (
+        _at._best_score if _at is not None else None)
+    extras["autotune_config"] = (
+        _at.active_config() if _at is not None else None)
     # Device-memory & compile accounting when HOROVOD_MEMLEDGER is on
     # (docs/observability.md "Memory & compile ledger"). Same
     # None-when-off convention: the driver's trend tooling must tell

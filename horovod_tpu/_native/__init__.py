@@ -291,6 +291,20 @@ class StagingRing:
             self._tokens = [None] * self.slots
             self._used = [False] * self.slots
 
+    def set_slots(self, slots: int):
+        """Adopt a new slot count (autotuner ring-depth knob). Same
+        drop-and-release contract as ``resize``: in-flight consumers hold
+        their own buffer references, so shrinking never frees bytes a
+        pending transfer still reads."""
+        slots = max(1, int(slots))
+        with self._lock:
+            if slots == self.slots:
+                return
+            self.slots = slots
+            self._bufs = [None] * slots
+            self._tokens = [None] * slots
+            self._used = [False] * slots
+
 
 class FusionBuffer:
     """Fusion pack/unpack helper (reference fusion_buffer_manager.h:40 +
@@ -317,6 +331,9 @@ class FusionBuffer:
     def resize(self, nbytes: int):
         self.nbytes = nbytes
         self.ring.resize(nbytes)
+
+    def set_slots(self, slots: int):
+        self.ring.set_slots(slots)
 
     def allocated_bytes(self) -> int:
         """Staging-ring host bytes actually allocated (memledger pull)."""

@@ -335,7 +335,8 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 _ctx.autotuner = Autotuner(
                     _ctx.runtime, log_path=_ctx.config.autotune_log,
                     warmup_samples=_ctx.config.autotune_warmup_samples,
-                    max_samples=_ctx.config.autotune_max_samples)
+                    max_samples=_ctx.config.autotune_max_samples,
+                    config=_ctx.config)
                 _ctx.runtime.autotuner = _ctx.autotuner
                 _ctx.runtime.autotune_steps_per_sample = (
                     _ctx.config.autotune_steps_per_sample)

@@ -27,6 +27,18 @@ HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+# joint fast-path autotuner (utils/autotune.py; docs/autotune.md):
+# persisted winning-config file (all-or-nothing parse on reload), and the
+# convergence guardrail — a candidate regressing the goodput score by
+# >= REVERT_PCT percent for REVERT_WINDOWS consecutive sample windows is
+# reverted to the best known config and penalized in the optimizer
+HOROVOD_AUTOTUNE_TUNED_FILE = "HOROVOD_AUTOTUNE_TUNED_FILE"
+HOROVOD_AUTOTUNE_REVERT_PCT = "HOROVOD_AUTOTUNE_REVERT_PCT"
+HOROVOD_AUTOTUNE_REVERT_WINDOWS = "HOROVOD_AUTOTUNE_REVERT_WINDOWS"
+# fused-plan granularity: max tensors per fused chunk (0 = byte-bounded
+# only) — a joint-tuning knob (arXiv:2209.12769): smaller chunks overlap
+# better, larger chunks amortize dispatches (ops/queue.py chunking)
+HOROVOD_PLAN_CHUNK_TENSORS = "HOROVOD_PLAN_CHUNK_TENSORS"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
@@ -214,6 +226,13 @@ class RuntimeConfig:
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 20
     autotune_max_samples: int = 20
+    # joint autotuner extras (docs/autotune.md): winning-config file and
+    # the score-regression revert guardrail (X percent, K windows)
+    autotune_tuned_file: str = ""
+    autotune_revert_pct: float = 20.0
+    autotune_revert_windows: int = 2
+    # fused-plan granularity cap in tensors per chunk (0 = unbounded)
+    plan_chunk_tensors: int = 0
     stall_check_disable: bool = False
     stall_warning_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0
@@ -291,6 +310,13 @@ class RuntimeConfig:
                                               c.autotune_steps_per_sample)
         c.autotune_max_samples = get_int(HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
                                          c.autotune_max_samples)
+        c.autotune_tuned_file = get_str(HOROVOD_AUTOTUNE_TUNED_FILE)
+        c.autotune_revert_pct = get_float(HOROVOD_AUTOTUNE_REVERT_PCT,
+                                          c.autotune_revert_pct)
+        c.autotune_revert_windows = get_int(HOROVOD_AUTOTUNE_REVERT_WINDOWS,
+                                            c.autotune_revert_windows)
+        c.plan_chunk_tensors = get_int(HOROVOD_PLAN_CHUNK_TENSORS,
+                                       c.plan_chunk_tensors)
         c.stall_check_disable = get_bool(HOROVOD_STALL_CHECK_DISABLE)
         c.stall_warning_time_s = get_float(HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0)
         c.stall_shutdown_time_s = get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0)

@@ -801,13 +801,18 @@ def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
     if sum(sizes) == 0:
         return None
     nproc = ps.cross_size
-    use_quant = (quant is not None and nproc > 1
-                 and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
-                 and np.dtype(str(dtype)).kind == "f")
-    # quantized plans are flat (non-hierarchical): the wire win comes
+    wire_ok = (quant is not None and nproc > 1
+               and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+               and np.dtype(str(dtype)).kind == "f")
+    # bits=16 is the bf16 cast wire (compression.make_cast_spec): same
+    # chunk shape as the plain plan but the staged flat is bfloat16 —
+    # half the wire bytes, no scale metadata
+    use_cast = wire_ok and quant.bits == 16
+    use_quant = wire_ok and quant.bits in (8, 4)
+    # compressed plans are flat (non-hierarchical): the wire win comes
     # from the payload width, and the two-level split would requantize
     # at each level for no extra reduction in cross bytes
-    hier = (not use_quant and nproc > 1
+    hier = (not (use_quant or use_cast) and nproc > 1
             and _allreduce_hier(op, ps, nproc))
     # nproc + elastic generation in the signature: an elastic resize can
     # reuse the set name with a different world size (see _plan_epoch)
@@ -815,10 +820,14 @@ def fused_chunk_plan(ps: ProcessSet, op, prescale_factor, postscale_factor,
            tuple(names), tuple(shapes),
            str(dtype), int(op), float(prescale_factor),
            float(postscale_factor), bool(on_device), hier)
-    if use_quant:
+    if use_quant or use_cast:
         key = key + (quant.signature(),)
 
     def build():
+        if use_cast:
+            return _build_cast_fused_plan(
+                ps, nproc, op, float(prescale_factor),
+                float(postscale_factor), sizes, tuple(shapes), dtype)
         if use_quant:
             return _build_quant_fused_plan(
                 ps, nproc, op, float(prescale_factor),
@@ -973,6 +982,79 @@ def _build_quant_fused_plan(ps, nproc, op, pre, post, sizes, shapes, dtype,
                                run_j)
 
 
+class CastFusedChunkPlan:
+    """Compiled steady-state replay for one bf16 cast-wire fused chunk
+    (compression mode "bf16"): pack→prescale→cast-to-bf16 locally, stage
+    only the half-width rows, then widen→reduce→postscale→unpack in one
+    program. Same two-dispatch steady state as QuantFusedChunkPlan, no
+    scale metadata and no residual lifecycle (the cast is not blockwise)."""
+
+    __slots__ = ("ps", "nproc", "flat_size", "wire_bytes", "pre_bytes",
+                 "cast", "run")
+
+    def __init__(self, ps, nproc, flat_size, wire_bytes, pre_bytes, cast,
+                 run):
+        self.ps = ps
+        self.nproc = nproc
+        self.flat_size = flat_size
+        self.wire_bytes = wire_bytes
+        self.pre_bytes = pre_bytes
+        self.cast = cast
+        self.run = run
+
+    def execute(self, inputs):
+        """Dispatch the chunk for this process's per-tensor ``inputs``
+        (host tensors device_put explicitly first — same transfer-guard
+        contract as FusedChunkPlan.execute). Returns the output parts."""
+        inputs = [a if isinstance(a, jax.Array) else jax.device_put(a)
+                  for a in inputs]
+        g = _global_row_array(self.ps, self.cast(*inputs))
+        return self.run(g)
+
+    def execute_simulated(self, rank_inputs):
+        """Single-process lockstep drive of N virtual ranks (tests): run
+        ``cast`` per virtual rank, stack the bf16 payloads in place of the
+        cross-process staging, replay the same ``run`` program."""
+        rows = []
+        for arrs in rank_inputs:
+            arrs = [a if isinstance(a, jax.Array) else jax.device_put(a)
+                    for a in arrs]
+            rows.append(self.cast(*arrs))
+        return self.run(jnp.stack(rows))
+
+
+def _build_cast_fused_plan(ps, nproc, op, pre, post, sizes, shapes, dtype):
+    total = sum(sizes)
+    pre_bytes = total * np.dtype(str(dtype)).itemsize
+    wire_bytes = total * 2  # bfloat16 rows are the only staged payload
+
+    def cast(*arrs):
+        flat = [jnp.ravel(a).astype(jnp.float32) for a in arrs]
+        cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        if pre != 1.0:
+            cat = cat * pre
+        return cat.astype(jnp.bfloat16)
+
+    def run(g):
+        wide = g.astype(jnp.float32)
+        red = (jnp.mean(wide, axis=0) if op == ReduceOp.AVERAGE
+               else jnp.sum(wide, axis=0))
+        if post != 1.0:
+            red = red * post
+        parts = []
+        off = 0
+        for n, shape in zip(sizes, shapes):
+            parts.append(jnp.reshape(
+                lax.slice(red, (off,), (off + n,)), shape).astype(dtype))
+            off += n
+        return parts
+
+    run_j = (jax.jit(run, out_shardings=_replicated(ps)) if ps is not None
+             else jax.jit(run))
+    return CastFusedChunkPlan(ps, nproc, total, wire_bytes, pre_bytes,
+                              jax.jit(cast), run_j)
+
+
 def quant_sim_chunk_plan(world: int, op, prescale_factor, postscale_factor,
                          names, sizes, shapes, dtype, quant):
     """Simulated-world flavor of the quantized chunk plan: one process
@@ -989,6 +1071,10 @@ def quant_sim_chunk_plan(world: int, op, prescale_factor, postscale_factor,
            quant.signature())
 
     def build():
+        if quant.bits == 16:
+            return _build_cast_fused_plan(
+                None, int(world), op, float(prescale_factor),
+                float(postscale_factor), sizes, tuple(shapes), dtype)
         return _build_quant_fused_plan(
             None, int(world), op, float(prescale_factor),
             float(postscale_factor), sizes, tuple(shapes), dtype, quant)

@@ -148,10 +148,14 @@ DEFAULT_OPTOUT_PATTERNS = ("bias", "norm", "bn", "gamma", "beta",
 
 class QuantSpec(NamedTuple):
     """Static quantization signature — folded into fused-plan keys, so a
-    config change misses onto a fresh compiled program."""
+    config change misses onto a fresh compiled program.
 
-    bits: int            # 8 or 4
-    block: int           # elements per absmax block
+    ``bits=16`` is the bf16 cast wire (no blocks, no scales, no error
+    feedback — a lossless-exponent half-width cast); 8 and 4 are the
+    blockwise absmax formats."""
+
+    bits: int            # 16 (bf16 cast), 8 or 4
+    block: int           # elements per absmax block (unused for bits=16)
     error_feedback: bool
 
     @property
@@ -160,6 +164,44 @@ class QuantSpec(NamedTuple):
 
     def signature(self) -> tuple:
         return ("quant", self.bits, self.block, self.error_feedback)
+
+
+#: The closed set of runtime wire modes the autotuner's compression knob
+#: ranges over (docs/autotune.md) — also the accepted HOROVOD_COMPRESSION
+#: values (plus ""/"0"/"off" aliases for "none").
+WIRE_MODES = ("none", "bf16", "int8", "int4")
+
+
+def make_cast_spec() -> QuantSpec:
+    """The bf16 cast-wire spec: halves wire bytes by casting the fused
+    flat buffer to bfloat16 before staging (TPU-native 16-bit format;
+    same eligibility guardrails as the blockwise formats)."""
+    return QuantSpec(16, 1, False)
+
+
+def spec_for_mode(mode: str, block: Optional[int] = None,
+                  error_feedback: Optional[bool] = None) -> Optional[QuantSpec]:
+    """Wire spec for one of ``WIRE_MODES`` — None for the uncompressed
+    wire, ValueError for anything outside the closed set (a torn or
+    mistyped config must fail loudly, never silently ship plain bytes)."""
+    mode = (mode or "").strip().lower()
+    if mode in ("", "none", "0", "off"):
+        return None
+    if mode == "bf16":
+        return make_cast_spec()
+    if mode == "int8":
+        return make_quant_spec(8, block, error_feedback)
+    if mode == "int4":
+        return make_quant_spec(4, block, error_feedback)
+    raise ValueError(f"unknown compression mode {mode!r}: supported values "
+                     f"are {'|'.join(WIRE_MODES)}")
+
+
+def mode_of_spec(spec: Optional[QuantSpec]) -> str:
+    """Inverse of ``spec_for_mode`` (the autotuner's active-config view)."""
+    if spec is None:
+        return "none"
+    return {16: "bf16", 8: "int8", 4: "int4"}[spec.bits]
 
 
 def _positive_block(block: int, bits: int) -> int:
@@ -187,10 +229,11 @@ def resolve_quant_spec(config=None) -> Optional[QuantSpec]:
     """The runtime wire spec from ``HOROVOD_COMPRESSION`` (or an already
     parsed RuntimeConfig) — None when the wire stays uncompressed.
 
-    Cast compression (fp16/bf16) remains a caller-side choice
-    (``Compression.bf16`` on the API); the env knob governs only the
-    runtime's fused-chunk wire, so unknown values fail loudly instead of
-    silently shipping uncompressed bytes."""
+    ``bf16`` selects the cast wire (make_cast_spec); ``int8``/``int4``
+    the blockwise formats. Per-call ``Compression.bf16`` markers remain a
+    caller-side choice on the API; this knob governs the runtime's
+    fused-chunk wire, so unknown values fail loudly instead of silently
+    shipping uncompressed bytes."""
     block = ef = None
     if config is not None:
         mode = (getattr(config, "compression", "") or "").strip().lower()
@@ -199,16 +242,10 @@ def resolve_quant_spec(config=None) -> Optional[QuantSpec]:
     else:
         mode = env_schema.get_str(env_schema.HOROVOD_COMPRESSION) \
             .strip().lower()
-    if mode in ("", "none", "0", "off"):
-        return None
-    if mode == "int8":
-        return make_quant_spec(8, block, ef)
-    if mode == "int4":
-        return make_quant_spec(4, block, ef)
-    raise ValueError(
-        f"{env_schema.HOROVOD_COMPRESSION}={mode!r}: supported values are "
-        "none|int8|int4 (fp16/bf16 cast compression is selected per call "
-        "via Compression.fp16/Compression.bf16, not the env knob)")
+    try:
+        return spec_for_mode(mode, block, ef)
+    except ValueError as e:
+        raise ValueError(f"{env_schema.HOROVOD_COMPRESSION}: {e}") from None
 
 
 def quant_optout_patterns() -> Tuple[str, ...]:

@@ -268,6 +268,28 @@ class KVController:
                                        stall_shutdown_s=stall_shutdown_s)
             self._coord.start()
 
+    def set_group_size(self, k: int):
+        """Adopt a new hierarchical group size (autotuner knob). Called
+        from ``on_params`` at response receipt — every rank applies it at
+        the same round boundary, so the recomputed groups agree before
+        the next round's submission. All per-channel caches are dropped
+        (channel re-handshake): a SAME_AS_LAST marker, a leader-side
+        member cache, or an aggregate payload from the old grouping must
+        never be replayed against the new channels."""
+        k = max(1, int(k))
+        if k == self._group_size:
+            return
+        self._group_size = k
+        self._group = self.rank // k
+        self._group_ranks = list(range(
+            self._group * k, min((self._group + 1) * k, self.size)))
+        self._member_set = set(self._group_ranks)
+        self._member_cache.clear()
+        self._last_payload = None
+        self._last_agg = None
+        self._last_channel = "flat"
+        self._flat_until = 0
+
     def negotiate(self, pending: dict[str, list],
                   joined: bool = False,
                   shutting_down: bool = False) -> dict:

@@ -186,9 +186,15 @@ class BackgroundRuntime:
         # persistent ring sized to the fusion threshold
         from .._native import FusionBuffer
 
+        self.staging_ring_slots = max(
+            1, int(getattr(config, "staging_ring_slots", 4)))
         self.fusion_buffer = FusionBuffer(
             config.fusion_threshold_bytes,
-            slots=getattr(config, "staging_ring_slots", 4))
+            slots=self.staging_ring_slots)
+        # fused-plan granularity: max tensors per chunk (0 = byte-bounded
+        # only) — the autotuner's chunk knob (HOROVOD_PLAN_CHUNK_TENSORS)
+        self.plan_chunk_tensors = max(
+            0, int(getattr(config, "plan_chunk_tensors", 0)))
         # compiled fused-chunk plans (collectives.fused_chunk_plan) replay
         # the whole pack→reduce→unpack chain as one program per chunk;
         # HOROVOD_FUSED_PLAN_DISABLE falls back to the per-cycle eager chain
@@ -233,6 +239,10 @@ class BackgroundRuntime:
         self._m_by_op: dict[tuple, tuple] = {}
         self._m_enq: dict[str, Any] = {}
         self.autotuner = None  # attached by context.init when HOROVOD_AUTOTUNE
+        # resolved here (not getattr'd in the cycle loop) so the autotune
+        # hook below stays one is-None check when tuning is off
+        self.autotune_steps_per_sample = max(
+            1, int(getattr(config, "autotune_steps_per_sample", 20)))
         # join state (reference JoinOp / hvd.join(): a rank out of data keeps
         # participating in other ranks' collectives with zero contributions
         # until everyone has joined)
@@ -262,6 +272,10 @@ class BackgroundRuntime:
         # the zero-cost contract (tests/test_quantized.py asserts no
         # hvd_quant_* series exist when HOROVOD_COMPRESSION is unset).
         self._quant = compression_mod.resolve_quant_spec(config)
+        # ZeRO-1 mutual exclusion (docs/sharded_optimizer.md): with the
+        # sharded update on, the compression knob must stay "none" — the
+        # autotuner's validation path rejects proposals that violate it
+        self._sharded_update = bool(getattr(config, "sharded_update", False))
         # residual store / opt-out registry materialize lazily on the
         # first quantized group (a per-call Compression.int8 marker can
         # arrive with the env knob unset)
@@ -278,26 +292,123 @@ class BackgroundRuntime:
             # coordinator-side); the local inspector keeps the warning role
             self.stall.shutdown_time_s = 0.0
 
+    def _validate_tuned_params(self, p: dict) -> dict:
+        """Parse/validate a tuned-params dict into typed knob values,
+        raising BEFORE anything is applied — the all-or-nothing contract:
+        a torn or malformed proposal must never leave the runtime with
+        half a config (docs/autotune.md)."""
+        out = {}
+        if "fusion" in p:
+            v = int(p["fusion"])
+            if v <= 0:
+                raise ValueError(f"fusion threshold must be > 0, got {v}")
+            out["fusion"] = v
+        if "cycle" in p:
+            v = float(p["cycle"])
+            if not v > 0:
+                raise ValueError(f"cycle time must be > 0, got {v}")
+            out["cycle"] = v
+        if "ring_slots" in p:
+            v = int(p["ring_slots"])
+            if v < 1:
+                raise ValueError(f"ring slots must be >= 1, got {v}")
+            out["ring_slots"] = v
+        if "chunk" in p:
+            v = int(p["chunk"])
+            if v < 0:
+                raise ValueError(f"plan chunk tensors must be >= 0, got {v}")
+            out["chunk"] = v
+        if "compression" in p:
+            mode = str(p["compression"]).strip().lower() or "none"
+            # raises for anything outside the closed mode set
+            spec = compression_mod.spec_for_mode(mode)
+            if spec is not None and self._sharded_update:
+                raise ValueError(
+                    "compression is mutually exclusive with the sharded "
+                    "update (HOROVOD_SHARDED_UPDATE)")
+            out["compression"] = spec
+        if "hier_group" in p:
+            v = int(p["hier_group"])
+            if v < 1:
+                raise ValueError(f"hier group size must be >= 1, got {v}")
+            out["hier_group"] = v
+        for k in ("hier_ar", "hier_ag"):
+            if k in p:
+                out[k] = bool(p[k])
+        return out
+
     def _apply_tuned_params(self, p: dict):
         """Apply coordinator-synchronized tuning knobs (reference
         SynchronizeParameters): called from negotiate() at response
         receipt, so every rank switches knobs at the same round boundary
-        relative to the collectives it executes."""
+        relative to the collectives it executes. Validation is
+        all-or-nothing (nothing applies if any value is bad); every
+        boundary-moving knob routes through its setter, which invalidates
+        the affected cached state (plans / staging ring / hier channels)."""
         try:
-            self.set_fusion_threshold(int(p["fusion"]))
-            self.cycle_time_ms = float(p["cycle"])
-            if "hier_ar" in p or "hier_ag" in p:
+            knobs = self._validate_tuned_params(p)
+            if "fusion" in knobs:
+                self.set_fusion_threshold(knobs["fusion"])
+            if "cycle" in knobs:
+                self.cycle_time_ms = knobs["cycle"]
+            if "ring_slots" in knobs:
+                self.set_staging_slots(knobs["ring_slots"])
+            if "chunk" in knobs:
+                self.set_plan_chunk_tensors(knobs["chunk"])
+            if "compression" in knobs:
+                self.set_compression_spec(knobs["compression"])
+            if "hier_group" in knobs and self.controller is not None:
+                self.controller.set_group_size(knobs["hier_group"])
+            if "hier_ar" in knobs or "hier_ag" in knobs:
                 from ..common import context as ctx_mod
 
                 cfg = ctx_mod.context().config
                 cfg.hierarchical_allreduce = bool(
-                    p.get("hier_ar", cfg.hierarchical_allreduce))
+                    knobs.get("hier_ar", cfg.hierarchical_allreduce))
                 cfg.hierarchical_allgather = bool(
-                    p.get("hier_ag", cfg.hierarchical_allgather))
+                    knobs.get("hier_ag", cfg.hierarchical_allgather))
+                if "hier_group" in knobs:
+                    cfg.hier_group_size = knobs["hier_group"]
         finally:
             at = self.autotuner
             if at is not None and p.get("final"):
                 at.done = True
+
+    def set_staging_slots(self, slots: int):
+        """Adopt a new staging-ring depth (autotuner ring knob); a no-op
+        when unchanged — the ring rebuild drops idle buffers while
+        in-flight leases keep their own references."""
+        slots = max(1, int(slots))
+        if slots == self.staging_ring_slots:
+            return
+        self.staging_ring_slots = slots
+        try:
+            self.fusion_buffer.set_slots(slots)
+        except Exception:
+            LOG.exception("staging ring slot resize failed")
+
+    def set_plan_chunk_tensors(self, n: int):
+        """Adopt a new per-chunk tensor cap. Chunk boundaries move, so
+        cached fused-chunk plans are invalidated like a fusion-threshold
+        change — stale signatures would crowd live programs out of the
+        shared LRU."""
+        n = max(0, int(n))
+        if n == self.plan_chunk_tensors:
+            return
+        self.plan_chunk_tensors = n
+        C.invalidate_fused_plans()
+
+    def set_compression_spec(self, spec):
+        """Adopt a new runtime wire spec (None / cast / blockwise —
+        compression.spec_for_mode). Plans carry the quant signature in
+        their keys, but the old flavor's programs are dead weight in the
+        LRU, so the cache is dropped; the per-name fallback note set
+        resets so the new mode re-explains its fallbacks."""
+        if spec == self._quant:
+            return
+        self._quant = spec
+        self._quant_noted.clear()
+        C.invalidate_fused_plans()
 
     def set_fusion_threshold(self, nbytes: int):
         """Adopt a new fusion threshold. Chunk boundaries move, so the
@@ -565,15 +676,20 @@ class BackgroundRuntime:
             led.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
                             exec_s=self._perf_exec_s, tensors=len(batch),
                             straggler=self._perf_strag)
-        # autotune sampling on working cycles (reference: ParameterManager
-        # scores each cycle's bytes/sec, parameter_manager.h:88)
+        # autotune hook on working cycles (reference: ParameterManager
+        # scores each cycle's bytes/sec, parameter_manager.h:88) — one
+        # is-None check when tuning is off (the zero-cost contract gated
+        # by benchmarks/autotune_overhead.py); the workload signature
+        # feeding shift detection is computed inside the guard
         self.work_cycles += 1
-        steps = getattr(self, "autotune_steps_per_sample", 20)
-        if self.autotuner is not None and self.work_cycles % steps == 0:
-            try:
-                self.autotuner.sample()
-            except Exception:
-                LOG.exception("autotune sample failed")
+        at = self.autotuner
+        if at is not None:
+            at.note_cycle(batch)
+            if self.work_cycles % self.autotune_steps_per_sample == 0:
+                try:
+                    at.sample()
+                except Exception:
+                    LOG.exception("autotune sample failed")
 
     def _negotiate(self, batch: list[TensorEntry]) -> list[TensorEntry]:
         """One negotiation round: post the pending set, receive the
@@ -789,6 +905,28 @@ class BackgroundRuntime:
                 plain.append(e)
         return quant, plain
 
+    def _chunk_group(self, group: list[TensorEntry]) -> list[list[TensorEntry]]:
+        """Split a fusable group into dispatch chunks: byte-bounded by the
+        fusion threshold and (when ``plan_chunk_tensors`` > 0) capped at
+        that many tensors per chunk — the autotuner's granularity knob."""
+        chunk: list[TensorEntry] = []
+        nbytes = 0
+        chunks = []
+        cap = self.plan_chunk_tensors
+        for e in group:
+            sz = getattr(e.tensor, "nbytes", None)
+            if sz is None:  # explicit None check: nbytes == 0 is valid
+                sz = np.asarray(e.tensor).nbytes
+            if chunk and (nbytes + sz > self.fusion_threshold
+                          or (cap and len(chunk) >= cap)):
+                chunks.append(chunk)
+                chunk, nbytes = [], 0
+            chunk.append(e)
+            nbytes += sz
+        if chunk:
+            chunks.append(chunk)
+        return chunks
+
     def _run_fused_allreduce(self, group: list[TensorEntry]):
         """Fuse up to fusion_threshold bytes into one flat compiled psum
         (the MEMCPY_IN_FUSION_BUFFER → op → MEMCPY_OUT of
@@ -800,22 +938,7 @@ class BackgroundRuntime:
                 self._run_quant_allreduce(qgroup, spec)
             if not group:
                 return
-        # chunk the group by threshold
-        chunk: list[TensorEntry] = []
-        nbytes = 0
-        chunks = []
-        for e in group:
-            sz = getattr(e.tensor, "nbytes", None)
-            if sz is None:  # explicit None check: nbytes == 0 is valid
-                sz = np.asarray(e.tensor).nbytes
-            if chunk and nbytes + sz > self.fusion_threshold:
-                chunks.append(chunk)
-                chunk, nbytes = [], 0
-            chunk.append(e)
-            nbytes += sz
-        if chunk:
-            chunks.append(chunk)
-        for chunk in chunks:
+        for chunk in self._chunk_group(group):
             names = [e.name for e in chunk]
             t0 = time.perf_counter()
             if self.timeline:
@@ -924,22 +1047,8 @@ class BackgroundRuntime:
         error is never double-applied (tests/test_quantized.py chaos
         coverage). The store itself resets on elastic-generation change
         (compression.ResidualStore)."""
-        chunk: list[TensorEntry] = []
-        nbytes = 0
-        chunks = []
-        for e in group:
-            sz = getattr(e.tensor, "nbytes", None)
-            if sz is None:
-                sz = np.asarray(e.tensor).nbytes
-            if chunk and nbytes + sz > self.fusion_threshold:
-                chunks.append(chunk)
-                chunk, nbytes = [], 0
-            chunk.append(e)
-            nbytes += sz
-        if chunk:
-            chunks.append(chunk)
         store = self._quant_residuals
-        for chunk in chunks:
+        for chunk in self._chunk_group(group):
             names = [e.name for e in chunk]
             t0 = time.perf_counter()
             if self.timeline:
@@ -980,6 +1089,11 @@ class BackgroundRuntime:
                     compression_mod.record_quant_chunk(
                         plan.pre_bytes, plan.wire_bytes, spec.bits,
                         plan.n_blocks)
+                elif isinstance(plan, C.CastFusedChunkPlan):
+                    # bf16 cast wire: no scales, no residual lifecycle
+                    parts = plan.execute(arrs)
+                    compression_mod.record_quant_chunk(
+                        plan.pre_bytes, plan.wire_bytes, spec.bits, 0)
                 elif plan is not None:
                     # fused_chunk_plan declined the quant flavor (e.g. an
                     # unsupported op slipped through): plain plan dispatch

@@ -57,6 +57,7 @@ SITES = (
     "leader.merge",
     "elastic.spawn", "elastic.heartbeat",
     "metrics.push",
+    "autotune.propose",
 )
 
 MODES = ("drop", "delay", "error", "fail", "torn")

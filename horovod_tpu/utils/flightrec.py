@@ -52,6 +52,7 @@ CATEGORIES = (
     ("slo_breach", "declared SLO budget crossed its bound"),
     ("compile", "XLA program compiled for a cached plan"),
     ("leader_round", "node-leader negotiation round merged or fell back"),
+    ("autotune_step", "autotuner proposed/applied/reverted a config"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

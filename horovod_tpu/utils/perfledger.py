@@ -263,6 +263,29 @@ class PerfLedger:
             recs = list(self._ring)[len(self._ring) - n_new:] if n_new else []
         return total, recs
 
+    def window_score(self, cursor: int) -> Tuple[int, Optional[float], dict]:
+        """Goodput score over the records since ``cursor`` — the
+        autotuner's objective (docs/autotune.md): effective allreduce
+        bytes/sec discounted by the exposed-communication fraction,
+
+            score = allreduce_gbps * 1e9 * (1 - exposed_comm_frac)
+
+        so a config that moves bytes fast but leaves the step blocked on
+        negotiation scores below one that overlaps. Returns
+        ``(new_cursor, score, window_stats)``; score is None when the
+        window holds no records or no wire/exec activity (idle windows
+        must not be scored — the autotuner skips them rather than
+        observing a fake zero)."""
+        cursor, recs = self.records_since(cursor)
+        if not recs:
+            return cursor, None, {}
+        st = self.stats(records=recs)
+        gbps = st.get("allreduce_gbps", 0.0)
+        if gbps <= 0.0:
+            return cursor, None, st
+        frac = min(max(st.get("exposed_comm_frac", 0.0), 0.0), 1.0)
+        return cursor, gbps * 1e9 * (1.0 - frac), st
+
     def stats(self, records: Optional[List[dict]] = None) -> dict:
         """Flat derived-stat dict — the namespace SLO budgets bind to.
 

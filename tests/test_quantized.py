@@ -143,8 +143,12 @@ def test_resolve_quant_spec(monkeypatch):
     monkeypatch.setenv(env_schema.HOROVOD_QUANT_EF, "0")
     assert comp.resolve_quant_spec() == comp.QuantSpec(8, 128, False)
     monkeypatch.setenv(env_schema.HOROVOD_COMPRESSION, "bf16")
-    with pytest.raises(ValueError, match="Compression.fp16"):
-        comp.resolve_quant_spec()  # cast compression is API-side: loud
+    # bf16 is a first-class wire mode since the joint autotuner's
+    # compression knob (WIRE_MODES): resolves to the 16-bit cast spec
+    assert comp.resolve_quant_spec() == comp.make_cast_spec()
+    monkeypatch.setenv(env_schema.HOROVOD_COMPRESSION, "zstd")
+    with pytest.raises(ValueError, match="int8"):
+        comp.resolve_quant_spec()  # unknown mode stays loud
 
 
 def test_quant_spec_normalization():
