@@ -48,6 +48,41 @@ from horovod_tpu.parallel import data_parallel_step
 
 BASELINE_PER_DEVICE = 1656.82 / 16  # reference ResNet-101, img/s per GPU
 
+
+def _git_sha() -> "str | None":
+    """HEAD commit of the repo this bench ran from (None outside a git
+    checkout / without git): banked baselines must be attributable to
+    the code that produced them, not just a date."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def _knob_snapshot() -> dict:
+    """The ACTIVE RuntimeConfig as a flat JSON-able dict — post-env,
+    post-autotune (the runtime's live config object, which the autotuner
+    mutates in place), so a banked result records the knobs that
+    actually ran, not the defaults."""
+    import dataclasses
+
+    from horovod_tpu.common import context as _context_mod
+    from horovod_tpu.common.env import RuntimeConfig
+
+    cfg = getattr(_context_mod.context(), "config", None)
+    if not dataclasses.is_dataclass(cfg):
+        cfg = RuntimeConfig.from_env()
+    return {k: (v if isinstance(v, (int, float, bool, str, type(None)))
+                else str(v))
+            for k, v in dataclasses.asdict(cfg).items()}
+
 # FLOPs (2 x MACs — the standard MFU convention, and what XLA's own
 # cost_analysis counts). ResNet-50 fwd = 4.09 GMACs = 8.18 GFLOP/img at
 # 224^2; ResNet-101 = 7.8 GMACs. Rounds 1-4 mistakenly used the MAC
@@ -506,6 +541,24 @@ def main():
         extras["mem_peak_bytes"] = None
         extras["compile_seconds_total"] = None
         extras["plan_cache_program_bytes"] = None
+    # Step-anatomy critical path + headroom when HOROVOD_ANATOMY is on
+    # (docs/observability.md "Step anatomy & headroom"). Same
+    # None-when-off convention as the other observability extras.
+    arep = hvd.anatomy_report()
+    if arep.get("enabled"):
+        _cp = arep.get("critical_path", {})
+        _hr = arep.get("headroom", {})
+        extras["anatomy_top_entity"] = _cp.get("top_entity")
+        extras["anatomy_overlap_headroom_s"] = _hr.get("overlap_headroom_s")
+        extras["anatomy_replay_headroom_s"] = _hr.get("replay_headroom_s")
+    else:
+        extras["anatomy_top_entity"] = None
+        extras["anatomy_overlap_headroom_s"] = None
+        extras["anatomy_replay_headroom_s"] = None
+    # Attribution stamp: which code and which knob snapshot produced
+    # these numbers — benchguard baselines are meaningless without it.
+    extras["git_sha"] = _git_sha()
+    extras["knobs"] = _knob_snapshot()
     if os.environ.get("HVD_BENCH_FALLBACK_REASON"):
         # honest metadata: this run is the forced-CPU fallback because the
         # TPU child failed/hung (wedged tunnel) — numbers are NOT chip

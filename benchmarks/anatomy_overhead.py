@@ -1,0 +1,77 @@
+"""Step-anatomy profiler overhead on the background cycle loop (CPU).
+
+Enforces the zero-cost contract of horovod_tpu/utils/anatomy.py: with
+``HOROVOD_ANATOMY`` unset no profiler exists and every dispatch hook
+pays one ``is None`` check, so the anatomy-off build must sit inside
+measurement noise of the pre-anatomy baseline (the ISSUE 16 A/A
+acceptance gate: within 2%, checked against
+benchmarks/anatomy_budgets.json via tools/benchguard) — and the
+anatomy-on build (per-chunk entity dicts, one ring append and a token
+poll per working cycle) must stay bounded, not free.
+
+Reuses the cycle_overhead.py harness (same synthetic 20-tensor fused
+workload, same inline ``run_cycle()`` timing) through the shared A/A
+harness in _common.py; the only variable here is the process
+profiler's presence.
+
+Run directly for a JSON line:
+
+    JAX_PLATFORMS=cpu python benchmarks/anatomy_overhead.py
+
+or import ``measure_anatomy()`` (the tier-1 smoke test in
+tests/test_anatomy.py does, with small cycle counts and a loose bound,
+so a hot-path regression surfaces in CI rather than on a chip window).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
+    sys.path.insert(1, _HERE)
+
+import _common  # noqa: E402  (benchmarks/ sibling)
+import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
+
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
+
+
+def measure_anatomy(anatomy_on: bool, cycles: int = 50,
+                    warmup: int = 5) -> dict:
+    """cycle_overhead.measure (plans enabled) with the process anatomy
+    profiler toggled for the runtime under test. Restores the
+    profiler-less state on exit so callers / later tests see the
+    default."""
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.utils import anatomy as anatomy_mod
+
+    try:
+        if anatomy_on:
+            os.environ[env_schema.HOROVOD_ANATOMY] = "1"
+            anatomy_mod.init_profiler(rank=0)
+        else:
+            os.environ.pop(env_schema.HOROVOD_ANATOMY, None)
+            anatomy_mod.reset_profiler()
+        out = cycle_overhead.measure(plans_enabled=True, cycles=cycles,
+                                     warmup=warmup)
+    finally:
+        os.environ.pop(env_schema.HOROVOD_ANATOMY, None)
+        anatomy_mod.reset_profiler()
+    out["anatomy_on"] = anatomy_on
+    return out
+
+
+def main() -> int:
+    # Two anatomy-off configs establish the A/A noise floor on this
+    # host; anatomy-off must sit within that floor (+ margin) of the
+    # baseline, because with the profiler None the two runs execute
+    # identical code. Interleaving/pairing rationale lives in
+    # _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_anatomy, "anatomy")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,23 @@ def memory_report() -> dict:
     return _memledger.report()
 
 
+def anatomy_report() -> dict:
+    """This rank's step-anatomy profile (utils/anatomy.py): the
+    per-entity aggregate table (named chunks, negotiation rounds, host
+    gaps, compile events — each with span and exposed-comm seconds), the
+    critical-path summary (which entity bounds the most steps), and the
+    Amdahl-style headroom estimates — ``overlap_headroom_s`` (step
+    seconds recoverable by fully overlapping dispatched collectives) and
+    ``replay_headroom_s`` (step seconds recoverable by eliminating
+    negotiation + host gap via plan replay). ``{"enabled": False}``
+    unless HOROVOD_ANATOMY was set at init. The merged cross-rank view
+    is ``GET /anatomy`` on the launcher's rendezvous server
+    (docs/observability.md, "Step anatomy & headroom")."""
+    from .utils import anatomy as _anatomy
+
+    return _anatomy.report()
+
+
 def diagnose() -> dict:
     """The local diagnostic bundle (utils/diag.py): all-thread stacks,
     lockcheck state, a metrics snapshot, open tracing spans, the flight

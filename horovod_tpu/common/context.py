@@ -285,6 +285,12 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
 
         memledger_mod.init_ledger(rank=_ctx.global_set.cross_rank)
 
+        # step-anatomy profiler, same placement rationale: the queue's
+        # dispatch hooks resolve the profiler handle once at build time
+        from ..utils import anatomy as anatomy_mod
+
+        anatomy_mod.init_profiler(rank=_ctx.global_set.cross_rank)
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)

@@ -148,6 +148,12 @@ HOROVOD_KV_SHARDS = "HOROVOD_KV_SHARDS"
 HOROVOD_MEMLEDGER = "HOROVOD_MEMLEDGER"
 HOROVOD_MEMLEDGER_BUFFER = "HOROVOD_MEMLEDGER_BUFFER"
 HOROVOD_PLAN_CACHE_MAX_BYTES = "HOROVOD_PLAN_CACHE_MAX_BYTES"
+# step-anatomy profiler (utils/anatomy.py; docs/observability.md "Step
+# anatomy & headroom"): per-collective critical-path attribution and
+# overlap/replay headroom estimation — master switch and per-step
+# record-ring capacity
+HOROVOD_ANATOMY = "HOROVOD_ANATOMY"
+HOROVOD_ANATOMY_BUFFER = "HOROVOD_ANATOMY_BUFFER"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -282,6 +288,10 @@ class RuntimeConfig:
     memledger_enabled: bool = False
     memledger_buffer: int = 512
     plan_cache_max_bytes: int = 0
+    # step-anatomy profiler (utils/anatomy.py) — off by default
+    # (zero-cost contract: no hvd_anatomy_* series)
+    anatomy_enabled: bool = False
+    anatomy_buffer: int = 512
     # control-plane scale-out (ops/controller.py + runner/http_server.py)
     # — off by default: the negotiation wire is byte-identical to the
     # flat/JSON v1 protocol and no hvd_hier_*/wire-v2 series exist
@@ -357,6 +367,8 @@ class RuntimeConfig:
                                      c.memledger_buffer)
         c.plan_cache_max_bytes = get_int(HOROVOD_PLAN_CACHE_MAX_BYTES,
                                          c.plan_cache_max_bytes)
+        c.anatomy_enabled = get_bool(HOROVOD_ANATOMY)
+        c.anatomy_buffer = get_int(HOROVOD_ANATOMY_BUFFER, c.anatomy_buffer)
         c.hier_negotiation = get_bool(HOROVOD_HIER_NEGOTIATION)
         c.hier_group_size = get_int(HOROVOD_HIER_GROUP_SIZE,
                                     c.hier_group_size)

@@ -44,7 +44,9 @@ from typing import Any, Optional
 import numpy as np
 
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..utils import anatomy as anatomy_mod
 from ..utils import diag as diag_mod
+from ..utils import faults as faults_mod
 from ..utils import flightrec as flightrec_mod
 from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
@@ -262,6 +264,10 @@ class BackgroundRuntime:
         # (benchmarks/perfledger_overhead.py): a None handle keeps the
         # cycle loop at one is-None check per phase stamp
         self.ledger = perfledger_mod.get_ledger()
+        # step-anatomy profiler, same resolved-once contract
+        # (benchmarks/anatomy_overhead.py): a None handle keeps every
+        # dispatch hook at one is-None check
+        self.profiler = anatomy_mod.get_profiler()
         # per-cycle scratch the ledger hooks accumulate into (cycle
         # thread only): execute-window seconds and the round's worst
         # coordinator straggler verdict
@@ -580,8 +586,10 @@ class BackgroundRuntime:
         batch = self.queue.drain()
         cycle_t0 = time.perf_counter()
         led = self.ledger
+        profiler = self.profiler
+        timed = led is not None or profiler is not None
         t_neg = t_disp = 0.0
-        if led is not None:
+        if timed:
             self._perf_exec_s = 0.0
             self._perf_strag = None
         if batch:
@@ -622,9 +630,9 @@ class BackgroundRuntime:
                     if entry is not None:
                         self._finish(entry, None, err)
         if self.controller is not None:
-            _pt = time.perf_counter() if led is not None else 0.0
+            _pt = time.perf_counter() if timed else 0.0
             batch = self._negotiate(batch)
-            if led is not None:
+            if timed:
                 t_neg = time.perf_counter() - _pt
         elif self.process_set.cross_size > 1 and batch:
             # no rendezvous store: best-effort deterministic order
@@ -662,13 +670,13 @@ class BackgroundRuntime:
                 fusable.setdefault(key, []).append(e)
             else:
                 singles.append(e)
-        if led is not None:
+        if timed:
             _pt = time.perf_counter()
         for key, group in fusable.items():
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
-        if led is not None:
+        if timed:
             t_disp = time.perf_counter() - _pt
         wall = time.perf_counter() - cycle_t0
         self._m_cycle.observe(wall)
@@ -676,6 +684,11 @@ class BackgroundRuntime:
             led.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
                             exec_s=self._perf_exec_s, tensors=len(batch),
                             straggler=self._perf_strag)
+        if profiler is not None:
+            profiler.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
+                                 tensors=len(batch),
+                                 names=[e.name for e in batch],
+                                 straggler=self._perf_strag)
         # autotune hook on working cycles (reference: ParameterManager
         # scores each cycle's bytes/sec, parameter_manager.h:88) — one
         # is-None check when tuning is off (the zero-cost contract gated
@@ -751,7 +764,7 @@ class BackgroundRuntime:
                 self._finish(e, None, HorovodInternalError(msg))
         out = []
         strag = resp.get("strag") or {}
-        if self.ledger is not None and strag:
+        if (self.ledger is not None or self.profiler is not None) and strag:
             # worst verdict this round feeds the step record's straggler
             # field (the ledger decides whether it counts as stall)
             self._perf_strag = max(
@@ -977,8 +990,9 @@ class BackgroundRuntime:
                             e.span.t[tracing_mod.T_DISPATCH_START] = disp0
                             e.span.chunk_bytes = total_bytes
                             e.span.chunk_tensors = len(chunk)
-                if self.ledger is not None:
+                if self.ledger is not None or self.profiler is not None:
                     _xt = time.perf_counter()
+                faults_mod.fault_point("plan.dispatch")
                 if plan is not None:
                     parts = self._dispatch_plan(plan, arrs, on_dev)
                 else:
@@ -986,6 +1000,11 @@ class BackgroundRuntime:
                                                   sizes, shapes)
                 if self.ledger is not None:
                     self._perf_exec_s += time.perf_counter() - _xt
+                if self.profiler is not None:
+                    self.profiler.note_chunk(
+                        names, total_bytes, len(chunk),
+                        time.perf_counter() - _xt,
+                        token=parts[0] if parts else None, t0_pc=_xt)
                 if self.tracer is not None:
                     disp1 = time.time()
                     for e in chunk:
@@ -1077,6 +1096,9 @@ class BackgroundRuntime:
                             e.span.t[tracing_mod.T_DISPATCH_START] = disp0
                             e.span.chunk_bytes = total_bytes
                             e.span.chunk_tensors = len(chunk)
+                if self.profiler is not None:
+                    _xt = time.perf_counter()
+                faults_mod.fault_point("plan.dispatch")
                 if isinstance(plan, C.QuantFusedChunkPlan):
                     rkey = (tuple(names), spec.signature())
                     residual = (store.get(rkey, plan.flat_size)
@@ -1101,6 +1123,11 @@ class BackgroundRuntime:
                 else:
                     parts = self._dispatch_legacy(arrs, on_dev, e0, ps,
                                                   sizes, shapes)
+                if self.profiler is not None:
+                    self.profiler.note_chunk(
+                        names, total_bytes, len(chunk),
+                        time.perf_counter() - _xt,
+                        token=parts[0] if parts else None, t0_pc=_xt)
                 if self.tracer is not None:
                     disp1 = time.time()
                     for e in chunk:
@@ -1153,6 +1180,9 @@ class BackgroundRuntime:
             e.span.t[tracing_mod.T_DISPATCH_START] = time.time()
         try:
             ps = e.process_set or self.process_set
+            if self.profiler is not None:
+                _xt = time.perf_counter()
+            faults_mod.fault_point("plan.dispatch")
             if e.op == "allreduce":
                 r = C._eager_allreduce(e.tensor, e.reduce_op, ps,
                                        e.prescale_factor, e.postscale_factor)
@@ -1171,6 +1201,10 @@ class BackgroundRuntime:
             if nbytes is None:
                 nbytes = np.asarray(t).nbytes
             self.bytes_processed += nbytes
+            if self.profiler is not None:
+                self.profiler.note_chunk(
+                    [e.name], int(nbytes), 1, time.perf_counter() - _xt,
+                    token=r if hasattr(r, "is_ready") else None, t0_pc=_xt)
             m_bytes, m_lat, m_ops = self._op_metrics(
                 e.op, str(getattr(t, "dtype", None) or np.asarray(t).dtype))
             m_bytes.inc(int(nbytes))

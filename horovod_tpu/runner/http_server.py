@@ -133,6 +133,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_perf()
         if key == "memory":
             return self._do_memory()
+        if key == "anatomy":
+            return self._do_anatomy()
         if key == "shards":
             return self._do_shards()
         if not self._authorized():
@@ -253,7 +255,26 @@ class _KVHandler(BaseHTTPRequestHandler):
             if local is not None and buf.get("rank") == local.rank:
                 continue  # local tracer is this rank's fresher view
             buffers.append(buf)
-        body = json.dumps(tracing_mod.merge_chrome_trace(buffers)).encode()
+        # step-anatomy lanes + critical-path summary ride the same merge
+        # (utils/anatomy.py pushes under the "anatomy/" scope)
+        from ..utils import anatomy as anatomy_mod
+
+        anat_prefix = anatomy_mod.KV_SCOPE + "/"
+        anat_pushed = self.server.scan_prefix(anat_prefix)  # type: ignore[attr-defined]
+        anatomy = []
+        local_prof = anatomy_mod.get_profiler()
+        if local_prof is not None:
+            anatomy.append(local_prof.snapshot())
+        for k, v in sorted(anat_pushed.items()):
+            try:
+                buf = json.loads(v)
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+            if local_prof is not None and buf.get("rank") == local_prof.rank:
+                continue  # local profiler is this rank's fresher view
+            anatomy.append(buf)
+        body = json.dumps(tracing_mod.merge_chrome_trace(
+            buffers, anatomy=anatomy or None)).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -317,6 +338,46 @@ class _KVHandler(BaseHTTPRequestHandler):
             snap["stale"] = rank in stale
             ranks[rank] = snap
         local = perfledger_mod.get_ledger()
+        if local is not None and str(local.rank) not in ranks:
+            snap = local.snapshot()
+            snap["stale"] = False
+            ranks[str(local.rank)] = snap
+        body = json.dumps({"ranks": ranks}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_anatomy(self):
+        """``GET /anatomy``: merge every step-anatomy snapshot ranks
+        pushed under the ``anatomy/`` KV scope (utils/anatomy.py) into
+        one JSON view — per rank: the per-entity aggregate table, the
+        critical-path summary, overlap/replay headroom estimates, the
+        newest records, and a ``stale`` flag when that rank's push stamp
+        lags the newest push (same annotate-don't-drop policy as
+        ``/perf``). Auth-exempt read-only telemetry, same rationale as
+        ``/metrics``."""
+        import json
+
+        from ..utils import anatomy as anatomy_mod
+
+        scope_prefix = anatomy_mod.KV_SCOPE + "/"
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
+        entries = []
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank1"
+            rank = suffix[4:] if suffix.startswith("rank") else suffix
+            try:
+                entries.append((rank, json.loads(v)))
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+        stale = _stale_ranks(entries)
+        ranks = {}
+        for rank, snap in entries:
+            snap["stale"] = rank in stale
+            ranks[rank] = snap
+        local = anatomy_mod.get_profiler()
         if local is not None and str(local.rank) not in ranks:
             snap = local.snapshot()
             snap["stale"] = False

@@ -58,6 +58,7 @@ SITES = (
     "elastic.spawn", "elastic.heartbeat",
     "metrics.push",
     "autotune.propose",
+    "plan.dispatch",
 )
 
 MODES = ("drop", "delay", "error", "fail", "torn")

@@ -383,6 +383,11 @@ class MemLedger:
         pledger = perfledger_mod.get_ledger()
         if pledger is not None:
             pledger.note_compile(seconds)
+        from . import anatomy as anatomy_mod
+
+        profiler = anatomy_mod.get_profiler()
+        if profiler is not None:
+            profiler.note_compile(seconds)
         self.sample(event="plan_build")
 
     def compile_stats(self) -> dict:

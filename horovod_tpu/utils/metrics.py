@@ -501,6 +501,23 @@ class MetricsDumper:
                         json.dumps(msnap).encode())
         except Exception as e:
             LOG.debug("memory KV push failed: %s", e)
+        # step-anatomy push rides the same cadence; the pushed snapshots
+        # feed the launcher's GET /anatomy merge (and the anatomy lanes
+        # of GET /timeline)
+        try:
+            from . import anatomy as anatomy_mod
+
+            profiler = anatomy_mod.get_profiler()
+            if profiler is not None and self.kv_client is not None:
+                asnap = profiler.snapshot()
+                asnap["push_seq"] = self._push_seq
+                asnap["push_ts"] = time.time()
+                asnap["push_interval_s"] = self.interval_s
+                self.kv_client.put(
+                    anatomy_mod.KV_SCOPE, f"rank{self.rank}",
+                    json.dumps(asnap).encode())
+        except Exception as e:
+            LOG.debug("anatomy KV push failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

@@ -570,9 +570,10 @@ def init_ledger(rank: int = 0, stall_inspector=None) -> Optional[PerfLedger]:
 
 def reset_ledger() -> None:
     """Drop the process ledger and SLO engine (test/bench helper)."""
-    global _LEDGER, _ENGINE
+    global _LEDGER, _ENGINE, _STALL_WARNED
     _LEDGER = None
     _ENGINE = None
+    _STALL_WARNED = False
 
 
 def evaluate_slos() -> List[dict]:
@@ -584,14 +585,38 @@ def evaluate_slos() -> List[dict]:
     return engine.evaluate()
 
 
+# one-shot guard for the stall-attribution warning below; reset together
+# with the ledger so tests observe the warning deterministically
+_STALL_WARNED = False
+
+
 def report() -> dict:
     """``hvd.perf_report()`` body: ``{"enabled": False}`` when the ledger
     is off, else this rank's stats/phase decomposition plus the SLO
-    engine's budget states when one is armed."""
+    engine's budget states when one is armed.
+
+    Straggler/stall attribution comes from coordinator verdicts that
+    only exist when cross-rank tracing is on: without ``HOROVOD_TRACE``
+    the ``stall`` phase reads 0 because no verdicts arrive, not because
+    no rank stalled. The report marks that with
+    ``stall_attributed: False`` (and warns once) instead of silently
+    showing a clean decomposition."""
+    global _STALL_WARNED
     ledger = _LEDGER
     if ledger is None:
         return {"enabled": False}
     out = ledger.report()
+    from . import tracing as tracing_mod
+
+    attributed = tracing_mod.get_tracer() is not None
+    out["stall_attributed"] = attributed
+    if not attributed and not _STALL_WARNED:
+        _STALL_WARNED = True
+        LOG.warning(
+            "perf_report(): stall/straggler attribution needs "
+            "HOROVOD_TRACE=1 — the stall phase reads 0 because "
+            "coordinator straggler verdicts are unavailable, not because "
+            "no rank stalled.")
     engine = _ENGINE
     if engine is not None:
         out["slo"] = engine.state()

@@ -368,11 +368,18 @@ def reset_tracer():
 # Merged Chrome trace + reports
 # ---------------------------------------------------------------------------
 
-def merge_chrome_trace(buffers: list[dict]) -> dict:
+def merge_chrome_trace(buffers: list[dict],
+                       anatomy: Optional[list[dict]] = None) -> dict:
     """Merge per-rank span buffers (``Tracer.snapshot()`` dicts) into one
     Chrome trace-event object: pid = rank, tid 0 the full op span, one tid
     per phase lane, all timestamps shifted by the buffer's clock offset
-    into the rendezvous coordinator's timebase (microseconds)."""
+    into the rendezvous coordinator's timebase (microseconds).
+
+    ``anatomy`` optionally carries per-rank step-anatomy snapshots
+    (``AnatomyProfiler.snapshot()`` dicts): their chunk entities render
+    on one extra "anatomy" lane per rank (shifted by that rank's trace
+    clock offset when a trace buffer supplied one) and the merged
+    ``horovod`` block gains a per-rank ``critical_path`` summary."""
     events: list[dict] = []
     ranks_meta: dict[str, dict] = {}
     straggler_counts: dict[str, int] = {}
@@ -425,10 +432,42 @@ def merge_chrome_trace(buffers: list[dict]) -> dict:
                                "name": f"{rec.get('n', '?')}:{lane}",
                                "cat": lane, "ts": us[s0],
                                "dur": max(us[s1] - us[s0], 0.0)})
-    return {"traceEvents": events, "displayTimeUnit": "ms",
-            "horovod": {"ranks": ranks_meta,
-                        "stragglers": {"last_rank_counts": straggler_counts,
-                                       "total_wait_s": round(total_wait, 6)}}}
+    out = {"traceEvents": events, "displayTimeUnit": "ms",
+           "horovod": {"ranks": ranks_meta,
+                       "stragglers": {"last_rank_counts": straggler_counts,
+                                      "total_wait_s": round(total_wait, 6)}}}
+    if anatomy:
+        offsets = {r: m.get("clock_offset_s") or 0.0
+                   for r, m in ranks_meta.items()}
+        anatomy_tid = len(PHASE_LANES) + 1
+        critical: dict[str, dict] = {}
+        for buf in anatomy:
+            try:
+                rank = int(buf["rank"])
+            except (KeyError, TypeError, ValueError):
+                continue  # half-written push: skip, next poll catches up
+            offset = float(offsets.get(str(rank), 0.0))
+            cp = buf.get("critical_path")
+            if isinstance(cp, dict):
+                critical[str(rank)] = cp
+            lanes = buf.get("lanes") or []
+            if lanes:
+                events.append({"ph": "M", "pid": rank, "tid": anatomy_tid,
+                               "name": "thread_name",
+                               "args": {"name": "anatomy"}})
+            for ent in lanes:
+                try:
+                    ts0 = float(ent["ts0"])
+                    dur = float(ent.get("dur_s") or 0.0)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                events.append({"ph": "X", "pid": rank, "tid": anatomy_tid,
+                               "name": str(ent.get("name", "?")),
+                               "cat": "anatomy",
+                               "ts": (ts0 + offset) * 1e6,
+                               "dur": max(dur * 1e6, 0.0)})
+        out["horovod"]["critical_path"] = critical
+    return out
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:

@@ -249,6 +249,50 @@ def test_wallclock_rule_scoped_to_wire_modules():
     assert _findings(src, path="horovod_tpu/utils/tracing.py") == []
 
 
+ENDPOINT_SRC = '''
+class Handler:
+    def do_GET(self):
+        key = self.path.lstrip("/")
+        if key == "metrics":
+            return self._do_metrics()
+        if key == "mystery":
+            return self._do_mystery()
+        self.send_error(404)
+'''
+
+
+def test_endpoint_docs_flags_undocumented_get():
+    got = _findings(
+        ENDPOINT_SRC, path="horovod_tpu/runner/http_server.py",
+        project=_project(docs={"observability.md": "only GET /metrics"}))
+    assert len(got) == 1 and got[0].rule == "endpoint-docs"
+    assert "GET /mystery" in got[0].message
+
+
+def test_endpoint_docs_clean_when_documented():
+    docs = {"observability.md": "GET /metrics and GET /mystery rows"}
+    assert _findings(ENDPOINT_SRC,
+                     path="horovod_tpu/runner/http_server.py",
+                     project=_project(docs=docs)) == []
+    # word-boundary: "GET /metricsx" must not satisfy "GET /metrics"
+    got = _findings(
+        ENDPOINT_SRC, path="horovod_tpu/runner/http_server.py",
+        project=_project(
+            docs={"observability.md": "GET /metricsx, GET /mystery"}))
+    assert [f.rule for f in got] == ["endpoint-docs"]
+    assert "GET /metrics" in got[0].message
+
+
+def test_endpoint_docs_scoped_to_http_server():
+    # the same dispatch shape anywhere else is not an endpoint surface,
+    # and a missing observability.md stands the rule down
+    assert _findings(ENDPOINT_SRC, path="horovod_tpu/ops/example.py",
+                     project=_project(docs={"observability.md": ""})) == []
+    assert _findings(ENDPOINT_SRC,
+                     path="horovod_tpu/runner/http_server.py",
+                     project=_project(docs={})) == []
+
+
 # ---------------------------------------------------- tier-1 gate + CLI
 
 

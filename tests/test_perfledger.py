@@ -205,6 +205,37 @@ def test_counter_deltas_ride_records(ledger):
     assert gbps > 0
 
 
+def test_perf_report_marks_unattributed_stall(ledger, caplog, monkeypatch):
+    """Bugfix: without HOROVOD_TRACE the stall phase reads 0 because no
+    coordinator verdicts arrive — perf_report() used to present that as
+    a clean decomposition. It now marks the field unattributed and warns
+    exactly once per ledger lifetime."""
+    from horovod_tpu.utils import tracing
+
+    ledger(rank=0).record_step(0.01, negotiate_s=0.004)
+    assert tracing.get_tracer() is None
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        rep = hvd.perf_report()
+        rep2 = hvd.perf_report()
+    assert rep["enabled"] and rep["stall_attributed"] is False
+    assert rep2["stall_attributed"] is False
+    warned = [r for r in caplog.records if "HOROVOD_TRACE" in r.getMessage()]
+    assert len(warned) == 1  # once, not per call
+    # with tracing armed the verdicts flow: attributed, no warning
+    monkeypatch.setenv("HOROVOD_TRACE", "1")
+    tracing.reset_tracer()
+    tracing.init_tracer(rank=0)
+    try:
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            rep3 = hvd.perf_report()
+        assert rep3["stall_attributed"] is True
+        assert not [r for r in caplog.records
+                    if "HOROVOD_TRACE" in r.getMessage()]
+    finally:
+        tracing.reset_tracer()
+
+
 # --- SLO budget engine -------------------------------------------------------
 
 def test_parse_slo_spec_forms(tmp_path):

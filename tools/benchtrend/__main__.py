@@ -1,0 +1,41 @@
+"""CLI: ``python -m tools.benchtrend 'BENCH_r*.json' [--json]``.
+
+Exit status: 0 when at least one round rendered, 2 when the glob
+matched nothing readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import build_rows, load_rounds, render_markdown
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchtrend",
+        description="render the banked BENCH_r*.json trajectory as a "
+                    "markdown table with per-metric direction arrows")
+    ap.add_argument("pattern", nargs="?", default="BENCH_r*.json",
+                    help="glob of banked rounds (default: BENCH_r*.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the rows as JSON instead of markdown")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.pattern)
+    if not rounds:
+        print(f"benchtrend: nothing matched {args.pattern!r}",
+              file=sys.stderr)
+        return 2
+    rows = build_rows(rounds)
+    if args.as_json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(render_markdown(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -230,7 +230,7 @@ class FaultSitesRule:
 # contract says a disabled tracer/timeline/fault state costs one is-None
 # check, so nothing may allocate or read clocks before that check
 _GUARD_SUFFIXES = ("tracer", "timeline", "span", "auditor", "recorder",
-                   "watchdog", "ledger")
+                   "watchdog", "ledger", "profiler")
 _GUARD_NAMES = {"st", "state", "tl"}
 
 
@@ -413,6 +413,62 @@ class WallClockRule:
                     "cross-rank timestamps, time.monotonic() for durations")
 
 
+#: module owning the launcher's HTTP endpoints (the one file
+#: EndpointDocsRule applies to)
+HTTP_SERVER_REL = "runner/http_server.py"
+
+
+class EndpointDocsRule:
+    """Every auth-exempt GET endpoint dispatched in runner/http_server.py
+    (``if key == "<name>": return self._do_<...>()`` inside ``do_GET``)
+    must be documented in docs/observability.md as ``GET /<name>`` —
+    telemetry surfaces operators can hit must never be undocumented."""
+
+    name = "endpoint-docs"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.endswith(HTTP_SERVER_REL):
+            return
+        seen = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name != "do_GET":
+                continue
+            for node in ast.walk(fn):
+                endpoint = self._dispatch_endpoint(node)
+                if endpoint is None or endpoint in seen:
+                    continue
+                seen.add(endpoint)
+                token = f"GET /{endpoint}"
+                if not ctx.project.doc_mentions("observability.md", token):
+                    yield Finding(
+                        self.name, ctx.path, node.lineno,
+                        f"auth-exempt endpoint {token!r} is not documented "
+                        "in docs/observability.md")
+
+    @staticmethod
+    def _dispatch_endpoint(node) -> str | None:
+        """The endpoint name of an ``if key == "<name>": ... self._do_*()``
+        dispatch arm, else None."""
+        if not isinstance(node, ast.If) or not isinstance(node.test, ast.Compare):
+            return None
+        t = node.test
+        if len(t.ops) != 1 or not isinstance(t.ops[0], ast.Eq):
+            return None
+        endpoint = _str_const(t.comparators[0])
+        if endpoint is None:
+            return None
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr.startswith("_do_") \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id == "self":
+                    return endpoint
+        return None
+
+
 def make_rules() -> List:
     """Fresh instances of every active rule (stateful rules accumulate
     per-run, so each run_lint() gets its own set)."""
@@ -424,4 +480,5 @@ def make_rules() -> List:
         ZeroCostHooksRule(),
         LockDisciplineRule(),
         WallClockRule(),
+        EndpointDocsRule(),
     ]
