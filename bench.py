@@ -555,6 +555,18 @@ def main():
         extras["anatomy_top_entity"] = None
         extras["anatomy_overlap_headroom_s"] = None
         extras["anatomy_replay_headroom_s"] = None
+    # Async-checkpoint write/restore costs when HOROVOD_ASYNC_CKPT is on
+    # (docs/fault_tolerance.md "Surviving preemption"). Same
+    # None-when-off convention as the other observability extras.
+    crep = hvd.checkpoint_report()
+    if crep.get("enabled"):
+        extras["ckpt_write_s"] = crep.get("last_write_s")
+        extras["ckpt_restore_s"] = crep.get("last_restore_s")
+        extras["ckpt_shard_bytes"] = crep.get("last_shard_bytes")
+    else:
+        extras["ckpt_write_s"] = None
+        extras["ckpt_restore_s"] = None
+        extras["ckpt_shard_bytes"] = None
     # Attribution stamp: which code and which knob snapshot produced
     # these numbers — benchguard baselines are meaningless without it.
     extras["git_sha"] = _git_sha()

@@ -1,0 +1,103 @@
+"""Async-checkpointer overhead on the background cycle loop (pure CPU).
+
+Enforces the zero-cost contract of horovod_tpu/utils/async_ckpt.py:
+with ``HOROVOD_ASYNC_CKPT`` unset no checkpointer exists and the hook
+sites (metrics-dumper push, bench extras) pay one ``is None`` check, so
+the checkpointer-off build must sit inside measurement noise of the
+pre-checkpoint baseline — and the on build must stay bounded: the only
+on-path cost a training step can see is the snapshot's device→host
+copy, because the writer thread owns all disk work and the depth-1
+newest-wins queue drops rather than blocks. The measured snapshot-copy
+stall is printed alongside the A/A verdict.
+
+Reuses the cycle_overhead.py harness (same synthetic 20-tensor fused
+workload, same inline ``run_cycle()`` timing); the only variable here
+is the process checkpointer's presence — a live idle writer thread in
+the on config, plus one real snapshot per measured run to report the
+copy stall.
+
+Run directly for a JSON line:
+
+    JAX_PLATFORMS=cpu python benchmarks/async_ckpt_overhead.py
+
+or import ``measure_async_ckpt()`` (the tier-1 smoke test in
+tests/test_async_ckpt.py does, with small cycle counts and a loose
+bound, so a hot-path regression surfaces in CI rather than on a chip
+window).
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
+    sys.path.insert(1, _HERE)
+
+import _common  # noqa: E402  (benchmarks/ sibling)
+import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
+
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
+
+
+def measure_async_ckpt(ckpt_on: bool, cycles: int = 50,
+                       warmup: int = 5) -> dict:
+    """cycle_overhead.measure (plans enabled) with the process async
+    checkpointer toggled for the runtime under test. The on config also
+    takes one representative snapshot (a ~4 MB pytree) so the JSON line
+    carries the measured snapshot-copy stall. Restores the
+    checkpointer-less state on exit so callers / later tests see the
+    default."""
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.utils import async_ckpt as async_ckpt_mod
+
+    tmpdir = None
+    try:
+        if ckpt_on:
+            tmpdir = tempfile.mkdtemp(prefix="hvd_ckpt_bench_")
+            os.environ[env_schema.HOROVOD_ASYNC_CKPT] = "1"
+            os.environ[env_schema.HOROVOD_ASYNC_CKPT_DIR] = tmpdir
+            async_ckpt_mod.init_checkpointer(rank=0, world=1)
+        else:
+            os.environ.pop(env_schema.HOROVOD_ASYNC_CKPT, None)
+            os.environ.pop(env_schema.HOROVOD_ASYNC_CKPT_DIR, None)
+            async_ckpt_mod.reset_checkpointer()
+        out = cycle_overhead.measure(plans_enabled=True, cycles=cycles,
+                                     warmup=warmup)
+        if ckpt_on:
+            import numpy as np
+
+            ckpt = async_ckpt_mod.get_checkpointer()
+            state = {"m": np.zeros(2 ** 20, np.float32),
+                     "v": np.zeros(2 ** 18, np.float32)}
+            ckpt.snapshot(0, state)
+            ckpt.flush(deadline_s=10.0)
+            out["snapshot_copy_s"] = round(ckpt.last_copy_s, 6)
+            out["shard_write_s"] = round(ckpt.last_write_s, 6)
+            out["shard_bytes"] = ckpt.last_shard_bytes
+    finally:
+        os.environ.pop(env_schema.HOROVOD_ASYNC_CKPT, None)
+        os.environ.pop(env_schema.HOROVOD_ASYNC_CKPT_DIR, None)
+        async_ckpt_mod.reset_checkpointer()
+        if tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    out["async_ckpt_on"] = ckpt_on
+    return out
+
+
+def main() -> int:
+    # Two checkpointer-off configs establish the A/A noise floor on this
+    # host; checkpointer-off must sit within that floor (+ margin) of
+    # the baseline, because with the checkpointer None the two runs
+    # execute identical code. Interleaving/pairing rationale lives in
+    # _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_async_ckpt, "async_ckpt")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

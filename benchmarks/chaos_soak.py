@@ -1,0 +1,528 @@
+"""Chaos soak gate: the whole fast path under rotating fault injection.
+
+The robustness acceptance run for the async shard checkpointer
+(utils/async_ckpt.py): a mixed workload — dense allreduce through the
+real background cycle loop, ZeRO-1 sharded updates on a simulated
+world, int8 quantized wire arithmetic, in-process hierarchical
+negotiation, the joint autotuner live on the runtime — driven for
+>= 200 steps while ``HOROVOD_FAULT_SPEC`` rotates through the
+control-plane fault sites (``leader.merge``, ``autotune.propose``,
+``plan.dispatch``, ``ckpt.write`` incl. ``torn``, ``ckpt.flush``),
+with an elastic resize up (2->3) and down (3->2) restored from disk
+shards mid-soak and a preemption drill (the SIGTERM handler body:
+snapshot -> deadline-bounded ``preempt_flush`` -> fresh engines ->
+restore) between them.
+
+The run executes TWICE — once faulted, once with every spec empty but
+an otherwise identical schedule (same seeds, same resizes, same
+restores) — and the verdict asserts:
+
+- **convergence equivalence**: final fp32 parameters and the full loss
+  trajectory bitwise-equal between the chaos run and the unfaulted run
+  (faults may only cost time, never numerics);
+- **zero leaked spans** (``tracing.open_spans() == 0``) and **zero lock
+  inversions** (``HOROVOD_LOCKCHECK=1``) after both runs;
+- **no SLO false latches**: the perf-ledger budget engine armed over
+  the whole soak fires nothing (injected delays must be absorbed, not
+  escalated);
+- **checkpoint accounting closes**: every accepted snapshot either
+  committed, was superseded (newest-wins), or failed loudly
+  (``snapshots == commits + dropped + failures``), and the committed
+  step advances strictly across all three generations;
+- **faults actually fired** in the chaos run (the gate is meaningless
+  if the rotation never triggered).
+
+Run directly for a JSON verdict line (exit 0 iff every invariant held):
+
+    JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --steps 200
+
+or import ``run_soak()`` — the slow-marked tier-1 gate in
+tests/test_async_ckpt.py runs this file as a subprocess so the chaos
+env/registry state can never leak into other tests.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# lock ordering + tracing + flight recorder + perf ledger are the
+# invariant witnesses — they must be armed before any horovod_tpu lock,
+# span, or runtime exists in the process
+os.environ.setdefault("HOROVOD_LOCKCHECK", "1")
+os.environ.setdefault("HOROVOD_TRACE", "1")
+os.environ.setdefault("HOROVOD_FLIGHTREC", "1")
+os.environ.setdefault("HOROVOD_PERFLEDGER", "1")
+os.environ.setdefault("HOROVOD_SLO_SPEC",
+                      "step_p95_ms<=60000,negotiate_p95_ms<=60000")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: Fault rotation, one spec per soak phase (ISSUE 17): warm clean, the
+#: negotiation/dispatch sites, the autotune/flush sites, the checkpoint
+#: write sites incl. a torn write, then a clean recovery tail proving
+#: the world heals once chaos stops.
+ROTATION = (
+    "",
+    "leader.merge:drop@0.25,plan.dispatch:delay=5ms@0.3",
+    "autotune.propose:fail#2,ckpt.flush:delay=20ms",
+    "ckpt.write:torn#1,ckpt.write:delay=10ms@0.5",
+    "",
+)
+
+#: World size per phase: resize up 2->3 entering phase 2 (restored from
+#: disk shards), preemption drill entering phase 3, resize down 3->2
+#: entering phase 4 — every transition is a restore-from-shards.
+PHASE_WORLDS = (2, 2, 3, 3, 2)
+
+CKPT_EVERY = 5     # snapshot cadence (training steps)
+CYCLE_EVERY = 10   # dense-allreduce cycle through the runtime queue
+
+#: negotiation-burst signature (tests/test_hier_negotiation.py shape)
+SIG = ["allreduce", "float32", [1024], 0, -1, 1.0, 1.0, "global", "host"]
+
+#: dense tensors enqueued per runtime cycle (kept small: the soak is a
+#: robustness gate, not a throughput bench)
+CYCLE_SHAPES = [(4096,), (256, 64), (1024,), (128, 32), (2500,), (777,)]
+
+
+def _params():
+    r = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    return {
+        "w1": jnp.asarray(r.randn(256, 256), jnp.float32),
+        "b1": jnp.asarray(r.randn(256), jnp.float32),
+        "big": jnp.asarray(r.randn(16384), jnp.float32),
+        "scale": jnp.asarray(1.5, jnp.float32),
+    }
+
+
+def _grads(params, world, step, quant_spec):
+    """Per-rank gradient trees, deterministic in (step, rank); the large
+    leaf rides the int8 quantized wire (quantize -> dequantize roundtrip
+    through ops/compression.py — the same blockwise absmax arithmetic
+    the fused quant plans compile in)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import compression as comp
+
+    out = []
+    for r in range(world):
+        g = jax.tree.map(
+            lambda p, r=r: jnp.asarray(
+                np.random.RandomState(97 * step + r).standard_normal(p.shape),
+                p.dtype), params)
+        flat = jnp.ravel(g["big"])
+        packed, scales = comp.quantize_blockwise(flat, quant_spec)
+        g["big"] = jnp.reshape(
+            comp.dequantize_blockwise(packed, scales, quant_spec,
+                                      flat.shape[0]),
+            g["big"].shape)
+        out.append(g)
+    return out
+
+
+def _loss(params):
+    import jax
+
+    return float(sum(float(np.sum(np.square(np.asarray(x))))
+                     for x in jax.tree.leaves(params)))
+
+
+def _make_runtime():
+    """A private, non-started BackgroundRuntime driven synchronously
+    (the benchmarks/cycle_overhead.py harness): dense allreduce through
+    the real cycle loop — negotiation skip, fused-chunk plans, the
+    ``plan.dispatch`` fault point, perf-ledger records."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import context as ctx_mod
+    from horovod_tpu.common.env import RuntimeConfig
+    from horovod_tpu.ops.queue import BackgroundRuntime
+
+    hvd.init()
+    cfg = RuntimeConfig()
+    cfg.stall_check_disable = True
+    cfg.autotune_steps_per_sample = 1
+    return BackgroundRuntime(ctx_mod.global_process_set(), cfg), cfg
+
+
+def _run_cycle(rt, arrays):
+    from horovod_tpu.ops.queue import TensorEntry
+
+    handles = [rt.enqueue(TensorEntry(name=f"soak.{i}", op="allreduce",
+                                      tensor=a))
+               for i, a in enumerate(arrays)]
+    rt.run_cycle()
+    for h in handles:
+        rt.handles.wait(h)
+
+
+def _negotiation_burst(nranks=4, group_size=2, fallback_s=1.0,
+                       timeout_s=120.0):
+    """One in-process hierarchical-negotiation world (N controllers on N
+    threads against a real RendezvousServer) through a warm + tensor +
+    steady schedule; raises if any rank wedges, desyncs, or errors —
+    the ``leader.merge`` faults must degrade to the flat path, never
+    lose a tensor."""
+    from horovod_tpu.ops.controller import KVController
+    from horovod_tpu.runner.http_server import KVStoreClient, RendezvousServer
+
+    schedule = [{"warm": SIG}, {f"t{j}": SIG for j in range(3)},
+                {"steady": SIG}]
+    srv = RendezvousServer()
+    port = srv.start()
+    results = [[] for _ in range(nranks)]
+    errs = []
+
+    def run(rank):
+        ctl = None
+        try:
+            cli = KVStoreClient("127.0.0.1", port)
+            ctl = KVController(cli, rank, nranks, poll_timeout=timeout_s,
+                               hier=True, hier_group_size=group_size,
+                               hier_fallback_s=fallback_s)
+            for pending in schedule:
+                resp = ctl.negotiate(dict(pending))
+                results[rank].append(sorted(resp["ready"]))
+        except Exception as e:  # pragma: no cover - surfaced via errs
+            errs.append((rank, repr(e)))
+        finally:
+            if ctl is not None:
+                try:
+                    ctl.stop()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True,
+                                name=f"soak-neg{r}")
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    hung = [t.name for t in threads if t.is_alive()]
+    srv.stop()
+    if hung:
+        raise RuntimeError(f"negotiation ranks wedged: {hung}")
+    if errs:
+        raise RuntimeError(f"negotiation ranks failed: {errs}")
+    for rank_res in results:
+        for ready, pending in zip(rank_res, schedule):
+            if ready != sorted(pending):
+                raise RuntimeError(
+                    f"negotiation desync: {ready} != {sorted(pending)}")
+
+
+def _labeled_counter_total(name):
+    from horovod_tpu.utils import metrics as metrics_mod
+
+    return sum(c["value"] for c in
+               metrics_mod.get_registry().snapshot()["counters"]
+               if c["name"] == name)
+
+
+def _ckpt_counters():
+    from horovod_tpu.utils import metrics as metrics_mod
+
+    reg = metrics_mod.get_registry()
+    return {k: reg.counter_value(f"hvd_ckpt_{k}_total")
+            for k in ("snapshots", "dropped", "commits", "failures",
+                      "restores")}
+
+
+def _make_world(opt, world, directory, params):
+    """Engines + per-rank checkpointers for one elastic generation."""
+    from horovod_tpu.opt import sharded as sharded_mod
+    from horovod_tpu.utils import async_ckpt
+
+    engines = sharded_mod.make_simulated_engines(opt, world)
+    for e in engines:
+        e.ensure_layout(params)
+    ckpts = [async_ckpt.AsyncCheckpointer(rank=r, world=world,
+                                          directory=directory)
+             for r in range(world)]
+    return engines, ckpts
+
+
+def _snapshot_all(ckpts, engines, step, states, params):
+    for r, (c, e) in enumerate(zip(ckpts, engines)):
+        c.snapshot(step, states[r],
+                   replicated=({"params": params} if r == 0 else None),
+                   layout=e.layout)
+
+
+def _flush_all(ckpts, deadline_s=30.0):
+    for c in ckpts:
+        if not c.flush(deadline_s=deadline_s):
+            raise RuntimeError(f"rank {c.rank} flush missed its deadline")
+
+
+def _restore_world(directory, params, engines, expect_step):
+    """Per-rank restore through the saved world's layout (N->M re-slice
+    when the worlds differ); every rank must land on the same committed
+    step."""
+    from horovod_tpu.utils import async_ckpt
+
+    states, replicated = [], None
+    for e in engines:
+        manifest, state, rep = async_ckpt.restore_sharded(
+            directory, params, e)
+        if manifest["step"] != expect_step:
+            raise RuntimeError(
+                f"restore landed on step {manifest['step']}, "
+                f"expected {expect_step} (stale manifest group won)")
+        states.append(state)
+        if rep is not None:
+            replicated = rep
+    return states, replicated
+
+
+def run_soak(steps=200, faulted=True, seed=0):
+    """One full soak pass; returns the verdict dict for this run. The
+    caller compares two passes (faulted vs not) for the convergence
+    invariant."""
+    import optax
+
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.ops import compression as comp
+    from horovod_tpu.opt import sharded as sharded_mod
+    from horovod_tpu.utils import (faults, flightrec, lockcheck, perfledger,
+                                   tracing)
+    from horovod_tpu.utils.autotune import Autotuner
+
+    os.environ[env_schema.HOROVOD_ELASTIC_GEN] = "0"
+    os.environ["HOROVOD_FAULT_SEED"] = str(seed)
+    faults.reset()
+    tracing.reset_tracer()
+    tracing.init_tracer(0)
+    flightrec.reset_recorder()
+    flightrec.init_recorder(0)
+    perfledger.reset_ledger()
+    perfledger.init_ledger(0)
+
+    rt, cfg = _make_runtime()
+    from horovod_tpu.common import context as ctx_mod
+
+    ctx_cfg = ctx_mod.context().config
+    hier_before = (ctx_cfg.hierarchical_allreduce,
+                   ctx_cfg.hierarchical_allgather)
+    rt.autotuner = Autotuner(rt, warmup_samples=0, max_samples=6,
+                             config=cfg, seed=seed)
+    rt.autotune_steps_per_sample = 1
+
+    cycle_arrays = [np.random.default_rng(i).standard_normal(s)
+                    .astype(np.float32) for i, s in enumerate(CYCLE_SHAPES)]
+    quant_spec = comp.QuantSpec(8, 256, True)
+    opt = optax.adam(1e-3)
+    tmpdir = tempfile.mkdtemp(prefix="hvd_chaos_soak_")
+    phase_steps = max(1, steps // len(ROTATION))
+    boundaries = [i * phase_steps for i in range(len(ROTATION))]
+    total_steps = phase_steps * len(ROTATION)
+
+    params = _params()
+    engines, ckpts = _make_world(opt, PHASE_WORLDS[0], tmpdir, params)
+    states = [e.init(params) for e in engines]
+    generation = 0
+    losses = []
+    slo_fired = []
+    phase_log = []
+    drill_bitwise_ok = True
+    try:
+        for phase, spec in enumerate(ROTATION):
+            world = PHASE_WORLDS[phase]
+            start = boundaries[phase]
+            if faulted and spec:
+                os.environ[faults.HOROVOD_FAULT_SPEC] = spec
+            else:
+                os.environ.pop(faults.HOROVOD_FAULT_SPEC, None)
+            faults.reset()
+
+            if phase > 0 and world != PHASE_WORLDS[phase - 1]:
+                # elastic resize: generation bump, fresh engines, state
+                # re-materialized from the disk shards of the old world
+                generation += 1
+                os.environ[env_schema.HOROVOD_ELASTIC_GEN] = str(generation)
+                sharded_mod.notify_reshard()
+                old_states = states
+                engines, new_ckpts = _make_world(opt, world, tmpdir, params)
+                states, replicated = _restore_world(
+                    tmpdir, params, engines, expect_step=start - 1)
+                del old_states
+                for c in ckpts:
+                    c.stop()
+                ckpts = new_ckpts
+                if replicated is not None:
+                    params = replicated["params"]
+            elif phase > 0 and phase == 3:
+                # preemption drill mid-soak, same world: the SIGTERM
+                # handler body (deadline-bounded preempt_flush), then a
+                # fresh incarnation restoring from its own shards
+                pre_states = states
+                for c in ckpts:
+                    if not c.preempt_flush(deadline_s=20.0):
+                        raise RuntimeError(
+                            f"rank {c.rank} preempt_flush missed deadline")
+                    c.stop()
+                engines, ckpts = _make_world(opt, world, tmpdir, params)
+                states, replicated = _restore_world(
+                    tmpdir, params, engines, expect_step=start - 1)
+                if replicated is not None:
+                    params = replicated["params"]
+                import jax
+
+                for a, b in zip(jax.tree.leaves(pre_states),
+                                jax.tree.leaves(states)):
+                    if not np.array_equal(np.asarray(a), np.asarray(b)):
+                        drill_bitwise_ok = False
+
+            for step in range(start, start + phase_steps):
+                gs = _grads(params, world, step, quant_spec)
+                params, states = sharded_mod.simulated_step(
+                    engines, params, gs, states)
+                losses.append(_loss(params))
+                if step % CKPT_EVERY == 0:
+                    _snapshot_all(ckpts, engines, step, states, params)
+                if step % CYCLE_EVERY == 0:
+                    _run_cycle(rt, cycle_arrays)
+
+            # one hierarchical-negotiation burst per phase, under this
+            # phase's spec (leader.merge chaos rides here)
+            _negotiation_burst()
+            # phase-end snapshot + flush: the durable step every
+            # transition restores from (flush retries absorb injected
+            # write faults, so the newest complete group is this step)
+            _snapshot_all(ckpts, engines, start + phase_steps - 1, states,
+                          params)
+            _flush_all(ckpts)
+            slo_fired.extend(perfledger.evaluate_slos())
+            ckpt_step = max(c.last_step for c in ckpts)
+            phase_log.append({"phase": phase, "world": world,
+                              "generation": generation,
+                              "spec": spec if faulted else "",
+                              "ckpt_step": ckpt_step})
+    finally:
+        for c in ckpts:
+            try:
+                c.stop()
+            except Exception:
+                pass
+        os.environ.pop(faults.HOROVOD_FAULT_SPEC, None)
+        faults.reset()
+        ctx_cfg.hierarchical_allreduce = hier_before[0]
+        ctx_cfg.hierarchical_allgather = hier_before[1]
+        rt.autotuner = None
+
+    counters = _ckpt_counters()
+    engine = perfledger.get_engine()
+    breaching = [b["budget"] for b in engine.state()["budgets"]
+                 if b["breaching"]] if engine is not None else []
+    rec = flightrec.get_recorder()
+    commit_events = sum(1 for e in rec.events()
+                        if e["cat"] == "checkpoint"
+                        and e["kv"].get("event") == "commit")
+    out = {
+        "faulted": faulted,
+        "steps": total_steps,
+        "phases": phase_log,
+        "losses": losses,
+        "final_params": {k: np.asarray(v) for k, v in params.items()},
+        "open_spans": tracing.get_tracer().open_spans(),
+        "lock_inversions": len(lockcheck.inversions()),
+        "slo_fired": slo_fired,
+        "slo_breaching": breaching,
+        "ckpt": counters,
+        "ckpt_accounting_closed": (
+            counters["snapshots"]
+            == counters["commits"] + counters["dropped"]
+            + counters["failures"]),
+        "ckpt_steps_monotonic": all(
+            a["ckpt_step"] < b["ckpt_step"]
+            for a, b in zip(phase_log, phase_log[1:])),
+        "commit_events": commit_events,
+        "faults_injected": _labeled_counter_total("hvd_fault_injected_total"),
+        "preempt_restore_bitwise": drill_bitwise_ok,
+    }
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
+def _strip(run):
+    """JSON-safe view of one run (drop arrays, compress the loss list)."""
+    out = {k: v for k, v in run.items() if k not in ("final_params",
+                                                     "losses")}
+    out["final_loss"] = run["losses"][-1]
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--steps", type=int, default=200,
+                    help="total soak steps (split across %d phases)"
+                         % len(ROTATION))
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    # counter DELTAS per run: the registry is process-global and the
+    # reference run fills it first
+    ref_base = _ckpt_counters()
+    reference = run_soak(steps=args.steps, faulted=False, seed=args.seed)
+    chaos_base = _ckpt_counters()
+    faults_base = _labeled_counter_total("hvd_fault_injected_total")
+    chaos = run_soak(steps=args.steps, faulted=True, seed=args.seed)
+    chaos["faults_injected"] -= faults_base
+    for run, base in ((reference, ref_base), (chaos, chaos_base)):
+        run["ckpt"] = {k: run["ckpt"][k] - base[k] for k in run["ckpt"]}
+        run["ckpt_accounting_closed"] = (
+            run["ckpt"]["snapshots"]
+            == run["ckpt"]["commits"] + run["ckpt"]["dropped"]
+            + run["ckpt"]["failures"])
+
+    params_equal = (
+        set(reference["final_params"]) == set(chaos["final_params"])
+        and all(np.array_equal(reference["final_params"][k],
+                               chaos["final_params"][k])
+                for k in reference["final_params"]))
+    losses_equal = reference["losses"] == chaos["losses"]
+
+    checks = {
+        "convergence_params_bitwise": params_equal,
+        "convergence_losses_equal": losses_equal,
+        "zero_leaked_spans": (reference["open_spans"] == 0
+                              and chaos["open_spans"] == 0),
+        "zero_lock_inversions": (reference["lock_inversions"] == 0
+                                 and chaos["lock_inversions"] == 0),
+        "no_slo_false_latches": (not reference["slo_fired"]
+                                 and not chaos["slo_fired"]
+                                 and not reference["slo_breaching"]
+                                 and not chaos["slo_breaching"]),
+        "ckpt_accounting_closed": (reference["ckpt_accounting_closed"]
+                                   and chaos["ckpt_accounting_closed"]),
+        "ckpt_steps_monotonic": (reference["ckpt_steps_monotonic"]
+                                 and chaos["ckpt_steps_monotonic"]),
+        "preempt_restore_bitwise": (reference["preempt_restore_bitwise"]
+                                    and chaos["preempt_restore_bitwise"]),
+        "chaos_actually_fired": chaos["faults_injected"] > 0,
+        "reference_unfaulted": reference["faults_injected"] == 0,
+    }
+    verdict = {
+        "bench": "chaos_soak",
+        "steps": chaos["steps"],
+        "checks": checks,
+        "reference": _strip(reference),
+        "chaos": _strip(chaos),
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,6 +157,20 @@ def anatomy_report() -> dict:
     return _anatomy.report()
 
 
+def checkpoint_report() -> dict:
+    """This rank's async-checkpoint status (utils/async_ckpt.py): the
+    checkpoint directory, newest durably committed step, last
+    snapshot-copy stall and background-write durations, committed shard
+    bytes, and whether a snapshot is queued or in flight.
+    ``{"enabled": False}`` unless HOROVOD_ASYNC_CKPT was set at init.
+    The merged cross-rank view is ``GET /checkpoint`` on the launcher's
+    rendezvous server (docs/fault_tolerance.md, "Surviving
+    preemption")."""
+    from .utils import async_ckpt as _async_ckpt
+
+    return _async_ckpt.report()
+
+
 def diagnose() -> dict:
     """The local diagnostic bundle (utils/diag.py): all-thread stacks,
     lockcheck state, a metrics snapshot, open tracing spans, the flight

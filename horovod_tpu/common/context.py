@@ -291,6 +291,16 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
 
         anatomy_mod.init_profiler(rank=_ctx.global_set.cross_rank)
 
+        # async shard checkpointer AFTER _start_diag(): its SIGTERM
+        # handler must capture diag's as the chain target, so a
+        # preemption flushes the in-flight snapshot first and dumps the
+        # diagnostic bundle second
+        from ..utils import async_ckpt as async_ckpt_mod
+
+        async_ckpt_mod.init_checkpointer(
+            rank=_ctx.global_set.cross_rank,
+            world=_ctx.global_set.cross_size)
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)

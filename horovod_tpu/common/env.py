@@ -154,6 +154,15 @@ HOROVOD_PLAN_CACHE_MAX_BYTES = "HOROVOD_PLAN_CACHE_MAX_BYTES"
 # record-ring capacity
 HOROVOD_ANATOMY = "HOROVOD_ANATOMY"
 HOROVOD_ANATOMY_BUFFER = "HOROVOD_ANATOMY_BUFFER"
+# preemption-tolerant async sharded checkpointing (utils/async_ckpt.py;
+# docs/fault_tolerance.md "Surviving preemption"): master switch, the
+# directory shard checkpoints + manifest land in, and the SIGTERM grace
+# window in seconds — the elastic driver waits this long between
+# forwarding SIGTERM and escalating to SIGKILL, and the worker-side
+# preemption handler bounds its final flush by the same budget
+HOROVOD_ASYNC_CKPT = "HOROVOD_ASYNC_CKPT"
+HOROVOD_ASYNC_CKPT_DIR = "HOROVOD_ASYNC_CKPT_DIR"
+HOROVOD_PREEMPT_GRACE_S = "HOROVOD_PREEMPT_GRACE_S"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -292,6 +301,12 @@ class RuntimeConfig:
     # (zero-cost contract: no hvd_anatomy_* series)
     anatomy_enabled: bool = False
     anatomy_buffer: int = 512
+    # preemption-tolerant async sharded checkpointing (utils/async_ckpt.py)
+    # — off by default (zero-cost contract: no hvd_ckpt_* series);
+    # async_ckpt_dir="" resolves to ./horovod_ckpt at init
+    async_ckpt: bool = False
+    async_ckpt_dir: str = ""
+    preempt_grace_s: float = 15.0
     # control-plane scale-out (ops/controller.py + runner/http_server.py)
     # — off by default: the negotiation wire is byte-identical to the
     # flat/JSON v1 protocol and no hvd_hier_*/wire-v2 series exist
@@ -369,6 +384,10 @@ class RuntimeConfig:
                                          c.plan_cache_max_bytes)
         c.anatomy_enabled = get_bool(HOROVOD_ANATOMY)
         c.anatomy_buffer = get_int(HOROVOD_ANATOMY_BUFFER, c.anatomy_buffer)
+        c.async_ckpt = get_bool(HOROVOD_ASYNC_CKPT)
+        c.async_ckpt_dir = get_str(HOROVOD_ASYNC_CKPT_DIR)
+        c.preempt_grace_s = get_float(HOROVOD_PREEMPT_GRACE_S,
+                                      c.preempt_grace_s)
         c.hier_negotiation = get_bool(HOROVOD_HIER_NEGOTIATION)
         c.hier_group_size = get_int(HOROVOD_HIER_GROUP_SIZE,
                                     c.hier_group_size)

@@ -53,6 +53,11 @@ class WorkerHandle:
     def terminate(self):
         raise NotImplementedError
 
+    def kill(self):
+        """Hard stop (SIGKILL escalation); defaults to terminate() for
+        handles with no harder signal (thread-backed test doubles)."""
+        self.terminate()
+
 
 class _SubprocessWorker(WorkerHandle):
     def __init__(self, popen: subprocess.Popen, stream_threads=()):
@@ -81,6 +86,12 @@ class _SubprocessWorker(WorkerHandle):
     def terminate(self):
         try:
             self.popen.send_signal(signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self):
+        try:
+            self.popen.kill()
         except ProcessLookupError:
             pass
 
@@ -324,12 +335,31 @@ class ElasticDriver:
         return None
 
     def _terminate(self, alive):
+        """Forward SIGTERM to every live worker, wait out the preemption
+        grace window (``HOROVOD_PREEMPT_GRACE_S``) so in-flight
+        checkpoint flushes and diag dumps can complete, then escalate
+        stragglers to SIGKILL — logging each decision with the rank and
+        elapsed time, so a kill that raced a flush is attributable."""
+        start = time.monotonic()
         for slot, h in alive.values():
             h.terminate()
-        deadline = time.monotonic() + 15
+        grace = env_schema.get_float(env_schema.HOROVOD_PREEMPT_GRACE_S,
+                                     15.0)
+        deadline = start + grace
         for slot, h in alive.values():
             while h.poll() is None and time.monotonic() < deadline:
                 time.sleep(0.05)
+            elapsed = time.monotonic() - start
+            if h.poll() is None:
+                LOG.warning(
+                    "elastic: worker rank %d did not exit within the "
+                    "%.1fs grace window after SIGTERM (%.1fs elapsed); "
+                    "escalating to SIGKILL", slot.rank, grace, elapsed)
+                h.kill()
+            else:
+                LOG.info(
+                    "elastic: worker rank %d exited %.1fs after SIGTERM "
+                    "(grace window %.1fs)", slot.rank, elapsed, grace)
         alive.clear()
 
     def stop(self):

@@ -264,3 +264,35 @@ class JaxState(ObjectState):
             else:
                 setattr(self, k, broadcast_object(v, root_rank=0))
         self.save()
+
+    def restore_from_shards(self, engine, *, params_attr: str = "params",
+                            opt_state_attr: str = "opt_state",
+                            directory: Optional[str] = None) -> Optional[int]:
+        """Restore the optimizer state from an async shard checkpoint
+        (utils/async_ckpt.py) written by a previous incarnation —
+        including the N→M resize case: saved shards are reassembled
+        through the *saved* world's deterministic layout and re-sliced
+        under ``engine``'s current one (the PR 7 ``full_state()``
+        contract). Replicated leaves saved by rank 0 are applied to any
+        matching state attributes (e.g. ``params``). Returns the
+        restored step, or None when the directory holds no complete,
+        checksum-clean snapshot (caller proceeds from the committed
+        object store, or cold)."""
+        from ..utils import async_ckpt
+
+        directory = (directory
+                     or env_schema.get_str(env_schema.HOROVOD_ASYNC_CKPT_DIR)
+                     or async_ckpt.DEFAULT_DIR)
+        params = getattr(self, params_attr)
+        try:
+            manifest, state, replicated = async_ckpt.restore_sharded(
+                directory, params, engine)
+        except async_ckpt.CheckpointError:
+            return None
+        setattr(self, opt_state_attr, state)
+        if isinstance(replicated, dict):
+            for k, v in replicated.items():
+                if k in self._attrs:
+                    setattr(self, k, v)
+        self.save()
+        return manifest["step"]

@@ -137,6 +137,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_anatomy()
         if key == "shards":
             return self._do_shards()
+        if key == "checkpoint":
+            return self._do_checkpoint()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -422,6 +424,58 @@ class _KVHandler(BaseHTTPRequestHandler):
             snap["stale"] = False
             ranks[str(local.rank)] = snap
         body = json.dumps({"ranks": ranks}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_checkpoint(self):
+        """``GET /checkpoint``: merge every async-checkpoint status
+        snapshot ranks pushed under the ``ckpt/`` KV scope
+        (utils/async_ckpt.py) into one JSON view — per rank: the newest
+        durably committed step, last write/copy durations, shard bytes,
+        queue state, and a ``stale`` flag when that rank's push stamp
+        lags the newest push (same annotate-don't-drop policy as
+        ``/perf``) — plus the launcher-side view of the newest
+        *consistent* on-disk manifest set when the checkpoint directory
+        is visible from this host. Auth-exempt read-only telemetry, same
+        rationale as ``/metrics`` — this is the endpoint an operator
+        polls to decide whether a preempted job left a restorable
+        snapshot behind."""
+        import json
+
+        from ..common import env as env_schema
+        from ..utils import async_ckpt as async_ckpt_mod
+
+        scope_prefix = async_ckpt_mod.KV_SCOPE + "/"
+        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
+        entries = []
+        for k, v in sorted(pushed.items()):
+            suffix = k[len(scope_prefix):]  # "rank1"
+            rank = suffix[4:] if suffix.startswith("rank") else suffix
+            try:
+                entries.append((rank, json.loads(v)))
+            except (ValueError, UnicodeDecodeError):
+                continue  # half-written push: skip, next poll catches up
+        stale = _stale_ranks(entries)
+        ranks = {}
+        for rank, snap in entries:
+            snap["stale"] = rank in stale
+            ranks[rank] = snap
+        local = async_ckpt_mod.get_checkpointer()
+        if local is not None and str(local.rank) not in ranks:
+            snap = local.snapshot_status()
+            snap["stale"] = False
+            ranks[str(local.rank)] = snap
+        manifest = None
+        ckpt_dir = (env_schema.get_str(env_schema.HOROVOD_ASYNC_CKPT_DIR)
+                    or (local.directory if local is not None else ""))
+        if ckpt_dir:
+            m = async_ckpt_mod.read_manifest(ckpt_dir)
+            if m is not None:
+                manifest = {k: v for k, v in m.items() if k != "ranks"}
+        body = json.dumps({"ranks": ranks, "manifest": manifest}).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

@@ -1,0 +1,608 @@
+"""Preemption-tolerant async sharded checkpointing.
+
+A preempted TPU-VM today loses all optimizer state: the PR 6 SIGTERM
+hook (utils/diag.py) dumps diagnostics and dies. This module is the
+durability layer on top of it. The ZeRO-1 sharded update
+(opt/sharded.py, arXiv:2004.13336) already leaves each rank holding
+exactly 1/N of the optimizer state, so checkpointing can be sharded,
+parallel, and off the critical path: each rank snapshots *its own
+shard* (plus the replicated leaves on rank 0), hands the host copy to a
+background writer thread, and keeps training — the writer streams the
+copy through utils/checkpoint.py atomically (same-directory tmp + fsync
++ rename) and stamps a per-rank manifest carrying the shard layout
+digest, elastic generation, step, and payload checksum.
+
+The hot path is bounded by a **snapshot-copy budget**: the only
+synchronous work :meth:`AsyncCheckpointer.snapshot` does is the
+device→host copy of this rank's shard; the write queue is depth-1 and
+newest-wins, so a slow disk drops superseded snapshots
+(``hvd_ckpt_dropped_total``) instead of ever blocking a step.
+
+Preemption sequence (installed from ``hvd.init()`` AFTER the diag crash
+hooks, so the chain runs durability-first): SIGTERM → flush the
+in-flight + pending snapshot, deadline-bounded via utils/retry.py by
+``HOROVOD_PREEMPT_GRACE_S`` → write the manifest → chain to the diag
+bundle dump → previous disposition (the process still dies of SIGTERM).
+The elastic driver forwards SIGTERM to workers and waits the same grace
+window before escalating to SIGKILL (elastic/driver.py).
+
+Restore (module functions — usable with the checkpointer off): the
+newest *consistent* manifest set (every rank of one (step, generation,
+layout-digest, world) present, checksums verified) names the snapshot;
+same-world ranks reload their own shard bitwise, and an N→M resize
+reassembles the full state by re-planning the saved world's layout
+(``plan_shard_layout`` is deterministic — digest-checked against the
+manifest), concatenating the shard leaves, and re-slicing through
+:meth:`opt.sharded.ShardedUpdateEngine.load_full_state`.
+
+Exposure: lazy ``hvd_ckpt_*`` series, ``checkpoint`` flightrec events,
+a ``ckpt/rank{k}`` KV push on the MetricsDumper cadence merged by the
+launcher's auth-exempt ``GET /checkpoint``.
+
+Zero-cost contract (same as utils/anatomy.py, gated by
+benchmarks/async_ckpt_overhead.py): with ``HOROVOD_ASYNC_CKPT`` unset
+no checkpointer exists, hook sites pay one ``is None`` check, and no
+``hvd_ckpt_*`` series is registered — metric handles are resolved in
+``AsyncCheckpointer.__init__``, lazily at enable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import signal
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from ..common import env as env_schema
+from ..common.exceptions import FaultInjectedError
+from . import faults, flightrec, lockcheck
+
+LOG = logging.getLogger("horovod_tpu")
+
+#: KV scope the MetricsDumper pushes per-rank checkpoint status under
+#: (``ckpt/rank{k}``); the launcher's ``GET /checkpoint`` merges it.
+KV_SCOPE = "ckpt"
+
+DEFAULT_DIR = "./horovod_ckpt"
+
+_SHARD_FMT = "shard_rank{rank}.ckpt"
+_MANIFEST_FMT = "manifest_rank{rank}.json"
+_MANIFEST_RE = re.compile(r"manifest_rank(\d+)\.json$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is unreadable, inconsistent, or fails its
+    checksum — restore callers decide whether to fall back to a cold
+    start (the elastic path does) or surface it."""
+
+
+def _sha1_file(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _to_host(tree):
+    """Host-numpy copy of a (possibly device-backed) pytree: device
+    buffers do not survive the TPU re-initialization a preemption causes
+    (elastic/state.py makes the same argument for its snapshots)."""
+    import copy
+
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: np.asarray(x).copy() if hasattr(x, "dtype")
+        else copy.deepcopy(x), tree)
+
+
+class AsyncCheckpointer:
+    """Per-rank async shard writer with a depth-1, newest-wins queue.
+
+    ``snapshot()`` is the training-loop hook: host-copy + enqueue, never
+    disk. The daemon writer commits each accepted snapshot as an atomic
+    shard file + manifest; ``preempt_flush()`` drains synchronously
+    under a deadline (the SIGTERM path).
+    """
+
+    def __init__(self, rank: int = 0, world: int = 1,
+                 directory: Optional[str] = None):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.directory = (directory
+                          or env_schema.get_str(
+                              env_schema.HOROVOD_ASYNC_CKPT_DIR)
+                          or DEFAULT_DIR)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = lockcheck.make_lock("async_ckpt.state")
+        self._pending: Optional[dict] = None  # guarded-by: _lock
+        self._inflight = False  # guarded-by: _lock
+        self._wakeup = threading.Event()
+        self._stop = threading.Event()
+        # freshest status for report()/KV pushes and the bench extras
+        self.last_copy_s = 0.0
+        self.last_write_s = 0.0
+        self.last_restore_s = 0.0
+        self.last_shard_bytes = 0
+        self.last_step: Optional[int] = None
+        from . import metrics as metrics_mod
+
+        reg = metrics_mod.get_registry()
+        self._m_snapshots = reg.counter(
+            "hvd_ckpt_snapshots_total",
+            "shard snapshots accepted by the async checkpointer")
+        self._m_dropped = reg.counter(
+            "hvd_ckpt_dropped_total",
+            "snapshots superseded before the writer committed them "
+            "(the snapshot-copy budget: newest wins, training never blocks)")
+        self._m_commits = reg.counter(
+            "hvd_ckpt_commits_total",
+            "shard checkpoint files committed (tmp + fsync + rename)")
+        self._m_failures = reg.counter(
+            "hvd_ckpt_failures_total",
+            "shard checkpoint commits that failed (kept training)")
+        self._m_bytes = reg.counter(
+            "hvd_ckpt_bytes_total", "committed shard checkpoint bytes")
+        self._m_write = reg.histogram(
+            "hvd_ckpt_write_seconds",
+            "background shard commit duration (shard file + manifest)",
+            buckets=metrics_mod.LATENCY_BUCKETS_S)
+        self._m_last_step = reg.gauge(
+            "hvd_ckpt_last_step", "newest durably committed step")
+        self._m_restores = reg.counter(
+            "hvd_ckpt_restores_total",
+            "shard-checkpoint restores served (incl. N->M re-slices)")
+        self._thread = threading.Thread(
+            target=self._writer_loop, daemon=True, name="hvd-async-ckpt")
+        self._thread.start()
+
+    # -- hot path -----------------------------------------------------------
+
+    def snapshot(self, step: int, shard: Any, *,
+                 replicated: Any = None, layout=None,
+                 generation: Optional[int] = None) -> bool:
+        """Accept one snapshot: ``shard`` is this rank's own slice of
+        state (under ZeRO-1, the per-rank combined optimizer state —
+        already 1/N), ``replicated`` the full replicated leaves (pass on
+        rank 0 only; other ranks' copies are identical by contract).
+        ``layout`` (a ShardLayout) stamps the digest that invalidates
+        the snapshot across reshards. Returns False when this snapshot
+        displaced a pending, not-yet-written one (slow disk)."""
+        t0 = time.perf_counter()
+        if generation is None:
+            generation = env_schema.get_int(env_schema.HOROVOD_ELASTIC_GEN, 0)
+        job = {
+            "rank": self.rank,
+            "world": self.world,
+            "step": int(step),
+            "generation": int(generation),
+            "layout_digest": getattr(layout, "digest", "") or "",
+            "shard_state": _to_host(shard),
+            "replicated": _to_host(replicated)
+            if replicated is not None else None,
+        }
+        self.last_copy_s = time.perf_counter() - t0
+        with self._lock:
+            displaced = self._pending is not None
+            self._pending = job
+        self._m_snapshots.inc()
+        if displaced:
+            self._m_dropped.inc()
+        self._wakeup.set()
+        return not displaced
+
+    # -- background writer --------------------------------------------------
+
+    def _writer_loop(self):
+        while not self._stop.is_set():
+            self._wakeup.wait(timeout=0.2)
+            self._wakeup.clear()
+            self._drain()
+
+    def _take(self) -> Optional[dict]:
+        with self._lock:
+            job = self._pending
+            self._pending = None
+            if job is not None:
+                self._inflight = True
+            return job
+
+    def _done(self):
+        with self._lock:
+            self._inflight = False
+
+    def _drain(self):
+        while True:
+            job = self._take()
+            if job is None:
+                return
+            try:
+                self._commit(job)
+            except Exception as e:
+                # checkpointing is opt-in durability: a failed commit is
+                # loud but must never take the training job down
+                self._m_failures.inc()
+                flightrec.note("checkpoint", event="commit_failed",
+                               step=job["step"], error=type(e).__name__)
+                LOG.warning("async ckpt: commit of step %d failed: %s",
+                            job["step"], e)
+            finally:
+                self._done()
+
+    def _commit(self, job: dict):
+        t0 = time.perf_counter()
+        faults.fault_point("ckpt.write")
+        from . import checkpoint as ckpt_mod
+
+        shard_path = os.path.join(
+            self.directory, _SHARD_FMT.format(rank=job["rank"]))
+        ckpt_mod.save_pytree(shard_path, job)
+        nbytes = os.path.getsize(shard_path)
+        manifest = {
+            "rank": job["rank"],
+            "world": job["world"],
+            "step": job["step"],
+            "generation": job["generation"],
+            "layout_digest": job["layout_digest"],
+            "checksum": _sha1_file(shard_path),
+            "bytes": nbytes,
+            "ts": time.time(),
+        }
+        from ..common.util import atomic_write_bytes
+
+        atomic_write_bytes(
+            os.path.join(self.directory,
+                         _MANIFEST_FMT.format(rank=job["rank"])),
+            json.dumps(manifest).encode())
+        dt = time.perf_counter() - t0
+        self.last_write_s = dt
+        self.last_shard_bytes = nbytes
+        self.last_step = job["step"]
+        self._m_commits.inc()
+        self._m_bytes.inc(nbytes)
+        self._m_write.observe(dt)
+        self._m_last_step.set(job["step"])
+        flightrec.note("checkpoint", event="commit", step=job["step"],
+                       generation=job["generation"], bytes=nbytes,
+                       digest=(job["layout_digest"] or "")[:12])
+
+    # -- flush (SIGTERM / shutdown path) ------------------------------------
+
+    def flush(self, deadline_s: Optional[float] = None) -> bool:
+        """Drain the in-flight and pending snapshot synchronously,
+        bounded by ``deadline_s``. Returns True when everything accepted
+        so far is durable on disk."""
+        faults.fault_point("ckpt.flush")
+        start = time.monotonic()
+
+        def _left() -> Optional[float]:
+            if deadline_s is None:
+                return None
+            return max(deadline_s - (time.monotonic() - start), 0.0)
+
+        # wait out a commit the writer thread already started
+        while True:
+            with self._lock:
+                busy = self._inflight
+            if not busy:
+                break
+            left = _left()
+            if left is not None and left <= 0:
+                return False
+            time.sleep(0.01)
+        job = self._take()
+        if job is None:
+            self._done()
+            return True
+        from .retry import RetryPolicy, call_with_retry
+
+        policy = RetryPolicy.from_env(
+            max_attempts=3, base_delay_s=0.05, deadline_s=_left(),
+            retryable=lambda e: isinstance(e, (OSError, FaultInjectedError)))
+        try:
+            call_with_retry("ckpt.flush", lambda: self._commit(job), policy)
+            return True
+        except Exception as e:
+            self._m_failures.inc()
+            LOG.warning("async ckpt: flush of step %d failed: %s",
+                        job["step"], e)
+            return False
+        finally:
+            self._done()
+
+    def preempt_flush(self, deadline_s: float) -> bool:
+        """The SIGTERM handler body: flush under the grace budget and
+        leave a breadcrumb either way."""
+        flightrec.note("checkpoint", event="preempt",
+                       deadline_s=round(deadline_s, 3))
+        ok = self.flush(deadline_s=deadline_s)
+        flightrec.note("checkpoint", event="preempt_flushed", ok=ok,
+                       step=self.last_step)
+        return ok
+
+    def stop(self):
+        """Shut the writer down after a best-effort flush (reset/test
+        helper; the preemption path uses :meth:`preempt_flush`)."""
+        self.flush(deadline_s=5.0)
+        self._stop.set()
+        self._wakeup.set()
+        self._thread.join(timeout=5.0)
+
+    # -- readers ------------------------------------------------------------
+
+    def snapshot_status(self) -> dict:
+        """Push payload for ``ckpt/rank{k}`` and the ``GET /checkpoint``
+        merge."""
+        with self._lock:
+            queued = self._pending is not None
+            inflight = self._inflight
+        return {"rank": self.rank, "world": self.world,
+                "dir": self.directory,
+                "last_step": self.last_step,
+                "last_write_s": round(self.last_write_s, 6),
+                "last_copy_s": round(self.last_copy_s, 6),
+                "last_restore_s": round(self.last_restore_s, 6),
+                "last_shard_bytes": self.last_shard_bytes,
+                "queued": queued, "inflight": inflight}
+
+    def report(self) -> dict:
+        out = self.snapshot_status()
+        out["enabled"] = True
+        return out
+
+
+# --------------------------------------------------------------------------
+# Restore: module functions, independent of the enable knob (a cold
+# restart must be able to read shards written by its previous life even
+# before hvd.init() re-creates a checkpointer).
+# --------------------------------------------------------------------------
+
+
+def read_manifest(directory: str) -> Optional[dict]:
+    """The newest *consistent* snapshot in ``directory``: per-rank
+    manifests grouped by (step, generation, layout digest, world); a
+    group wins only when every rank of its world is present (a stale
+    shard from a previous, larger world can never join it). Returns
+    ``{"step", "generation", "layout_digest", "world", "ranks": {...}}``
+    or None when no complete snapshot exists."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    entries: dict = {}
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(directory, name), "rb") as f:
+                entry = json.loads(f.read())
+        except (OSError, ValueError):
+            continue  # half-written manifest: the shard never committed
+        if int(entry.get("rank", -1)) != int(m.group(1)):
+            continue
+        key = (int(entry.get("step", -1)), int(entry.get("generation", 0)),
+               str(entry.get("layout_digest", "")), int(entry.get("world", 0)))
+        entries.setdefault(key, {})[int(entry["rank"])] = entry
+    best = None
+    for (step, gen, digest, world), ranks in entries.items():
+        if world <= 0 or set(ranks) != set(range(world)):
+            continue  # incomplete: some rank never flushed this step
+        if best is None or step > best["step"]:
+            best = {"step": step, "generation": gen,
+                    "layout_digest": digest, "world": world, "ranks": ranks}
+    return best
+
+
+def load_shards(directory: str, *,
+                verify: bool = True) -> Tuple[dict, List[dict]]:
+    """Load the newest consistent snapshot's per-rank shard payloads,
+    rank order. ``verify`` checks each shard file's sha1 against its
+    manifest (a torn write that somehow got committed fails here, not
+    as optimizer-state garbage)."""
+    manifest = read_manifest(directory)
+    if manifest is None:
+        raise CheckpointError(
+            f"no complete checkpoint in {directory!r} "
+            "(missing or inconsistent per-rank manifests)")
+    from . import checkpoint as ckpt_mod
+
+    payloads: List[dict] = []
+    for rank in range(manifest["world"]):
+        entry = manifest["ranks"][rank]
+        path = os.path.join(directory, _SHARD_FMT.format(rank=rank))
+        if verify:
+            digest = _sha1_file(path)
+            if digest != entry["checksum"]:
+                raise CheckpointError(
+                    f"checksum mismatch for rank {rank} shard {path!r}: "
+                    f"manifest {entry['checksum'][:12]} != file {digest[:12]}")
+        payload = ckpt_mod.load_pytree(path)
+        if (int(payload.get("step", -1)) != manifest["step"]
+                or payload.get("layout_digest", "")
+                != manifest["layout_digest"]):
+            raise CheckpointError(
+                f"rank {rank} shard {path!r} disagrees with its manifest "
+                "(step/layout digest)")
+        payloads.append(payload)
+    return manifest, payloads
+
+
+def assemble_full_state(manifest: dict, payloads: List[dict], params, *,
+                        min_shard_elems: Optional[int] = None):
+    """Reassemble the unsharded optimizer state from saved shards: the
+    saved world's layout is re-planned deterministically (digest-checked
+    against the manifest — a threshold or tree change since the save is
+    refused, not silently mis-sliced), shard leaves concatenate across
+    ranks and trim to their group's true extent, replicated leaves come
+    from rank 0. The disk-backed analogue of
+    ``opt.sharded.simulated_full_state``."""
+    import numpy as np
+    from jax import tree_util as jtu
+
+    from ..opt.sharded import _shard_group_for, plan_shard_layout
+
+    layout = plan_shard_layout(params, manifest["world"],
+                               min_shard_elems=min_shard_elems,
+                               generation=manifest["generation"])
+    if manifest["layout_digest"] and layout.digest != manifest["layout_digest"]:
+        raise CheckpointError(
+            f"saved layout digest {manifest['layout_digest'][:12]} does not "
+            f"reproduce ({layout.digest[:12]}): params tree or shard "
+            "threshold changed since the checkpoint was written")
+    states = [p["shard_state"] for p in payloads]
+    flats = [jtu.tree_flatten_with_path(s) for s in states]
+    treedef = flats[0][1]
+    out = []
+    for pos, (path, leaf) in enumerate(flats[0][0]):
+        g = _shard_group_for(layout, path, leaf)
+        if g is not None:
+            full = np.concatenate(
+                [np.ravel(np.asarray(flats[r][0][pos][1]))
+                 for r in range(manifest["world"])])
+            out.append(full[:g.total])
+        else:
+            out.append(leaf)
+    return jtu.tree_unflatten(treedef, out)
+
+
+def restore_sharded(directory: str, params, engine, *,
+                    verify: bool = True) -> Tuple[dict, Any, Any]:
+    """Restore a ZeRO-1 engine's per-rank state from a shard checkpoint,
+    re-slicing through the engine's *current* layout — the saved world
+    and the restoring world may differ (N→M resize). Returns
+    ``(manifest, state_for_this_rank, replicated)`` where ``replicated``
+    is rank 0's saved replicated tree (None when the writer passed
+    none)."""
+    t0 = time.perf_counter()
+    manifest, payloads = load_shards(directory, verify=verify)
+    mse = getattr(engine, "_mse", None)
+    full = assemble_full_state(manifest, payloads, params,
+                               min_shard_elems=mse)
+    state = engine.load_full_state(full, params)
+    ckpt = get_checkpointer()
+    if ckpt is not None:
+        ckpt._m_restores.inc()
+        ckpt.last_restore_s = time.perf_counter() - t0
+    flightrec.note("checkpoint", event="restore", step=manifest["step"],
+                   saved_world=manifest["world"],
+                   world=getattr(engine, "_world", None))
+    return manifest, state, payloads[0].get("replicated")
+
+
+def load_own_shard(directory: str, rank: int, *,
+                   verify: bool = True) -> Optional[dict]:
+    """Same-world fast path: this rank's saved payload verbatim (bitwise
+    state), or None when the newest consistent snapshot was written by a
+    different world size or does not cover ``rank``."""
+    try:
+        manifest, payloads = load_shards(directory, verify=verify)
+    except CheckpointError:
+        return None
+    if rank >= manifest["world"]:
+        return None
+    return payloads[rank]
+
+
+# --------------------------------------------------------------------------
+# Preemption handler: SIGTERM → deadline-bounded flush → chain to the
+# previously installed handler (the diag bundle dump, which itself
+# chains to the default disposition — the process still dies).
+# --------------------------------------------------------------------------
+
+_handler_installed = False
+
+
+def install_preemption_handler(ckpt: AsyncCheckpointer) -> None:
+    """Install after diag.install_crash_hooks() (common/context.py
+    ordering) so the chain runs flush-first, dump-second. Idempotent;
+    best-effort off the main thread."""
+    global _handler_installed
+    if _handler_installed:
+        return
+    _handler_installed = True
+    sig = getattr(signal, "SIGTERM", None)
+    if sig is None:
+        return
+    try:
+        prev = signal.getsignal(sig)
+
+        def _handler(signum, frame):
+            grace = env_schema.get_float(
+                env_schema.HOROVOD_PREEMPT_GRACE_S, 15.0)
+            # leave headroom inside the driver's grace window for the
+            # chained diag dump before SIGKILL lands
+            c = get_checkpointer()
+            if c is not None:
+                c.preempt_flush(deadline_s=max(grace * 0.8, 1.0))
+            if callable(prev):
+                prev(signum, frame)
+            elif prev != signal.SIG_IGN:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        signal.signal(sig, _handler)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
+
+def reset_preemption_handler_for_tests() -> None:
+    """Allow a test subprocess to re-install the handler (NOT an
+    uninstall)."""
+    global _handler_installed
+    _handler_installed = False
+
+
+# --------------------------------------------------------------------------
+# Process-global checkpointer (the utils/anatomy.py module-trio pattern):
+# get_checkpointer() returns None when HOROVOD_ASYNC_CKPT is off, and
+# every hook site costs exactly one is-None check in that state.
+# --------------------------------------------------------------------------
+
+_CHECKPOINTER: Optional[AsyncCheckpointer] = None
+
+
+def enabled() -> bool:
+    return env_schema.get_bool(env_schema.HOROVOD_ASYNC_CKPT)
+
+
+def get_checkpointer() -> Optional[AsyncCheckpointer]:
+    return _CHECKPOINTER
+
+
+def init_checkpointer(rank: int = 0,
+                      world: int = 1) -> Optional[AsyncCheckpointer]:
+    """Create the process checkpointer when ``HOROVOD_ASYNC_CKPT`` is
+    set (idempotent) and wire the SIGTERM preemption handler; no-op
+    returning None when off."""
+    global _CHECKPOINTER
+    if not enabled():
+        return _CHECKPOINTER
+    if _CHECKPOINTER is None:
+        _CHECKPOINTER = AsyncCheckpointer(rank=rank, world=world)
+        install_preemption_handler(_CHECKPOINTER)
+    return _CHECKPOINTER
+
+
+def reset_checkpointer() -> None:
+    """Stop and drop the process checkpointer (test/bench helper)."""
+    global _CHECKPOINTER
+    if _CHECKPOINTER is not None:
+        _CHECKPOINTER.stop()
+    _CHECKPOINTER = None
+
+
+def report() -> dict:
+    """``hvd.checkpoint_report()`` body: ``{"enabled": False}`` when the
+    checkpointer is off, else this rank's write/flush status."""
+    ckpt = _CHECKPOINTER
+    if ckpt is None:
+        return {"enabled": False}
+    return ckpt.report()

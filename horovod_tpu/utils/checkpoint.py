@@ -70,11 +70,26 @@ def save_pytree(path: str, tree: Any, *, format: str = "pickle"):
         return
     if format != "pickle":
         raise ValueError(f"unknown checkpoint format {format!r}")
+    from ..common.exceptions import FaultInjectedError
     from ..common.util import atomic_tmp
+    from . import faults
 
+    # Serialize first so the fault layer can tear the payload the way a
+    # mid-write crash would, then write same-directory tmp + fsync +
+    # rename: the committed path transitions valid → valid only.
+    payload = pickle.dumps(tree)
+    data = faults.corrupt("ckpt.write", payload)
     with atomic_tmp(path) as tmp:
         with open(tmp, "wb") as f:
-            pickle.dump(tree, f)
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if len(data) != len(payload):
+            # a ckpt.write:torn rule fired: the "crash" happened after the
+            # partial write and before the rename, so the tmp is discarded
+            # and the committed checkpoint (if any) stays readable.
+            raise FaultInjectedError(
+                f"injected torn write at {path!r} (HOROVOD_FAULT_SPEC)")
 
 
 def _resolve(path: str) -> str:

@@ -59,6 +59,7 @@ SITES = (
     "metrics.push",
     "autotune.propose",
     "plan.dispatch",
+    "ckpt.write", "ckpt.flush",
 )
 
 MODES = ("drop", "delay", "error", "fail", "torn")

@@ -53,6 +53,7 @@ CATEGORIES = (
     ("compile", "XLA program compiled for a cached plan"),
     ("leader_round", "node-leader negotiation round merged or fell back"),
     ("autotune_step", "autotuner proposed/applied/reverted a config"),
+    ("checkpoint", "async checkpoint snapshot/flush/restore lifecycle"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

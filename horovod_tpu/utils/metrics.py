@@ -518,6 +518,22 @@ class MetricsDumper:
                     json.dumps(asnap).encode())
         except Exception as e:
             LOG.debug("anatomy KV push failed: %s", e)
+        # async-checkpoint status push rides the same cadence; the pushed
+        # snapshots feed the launcher's GET /checkpoint merge
+        try:
+            from . import async_ckpt as async_ckpt_mod
+
+            ckpt = async_ckpt_mod.get_checkpointer()
+            if ckpt is not None and self.kv_client is not None:
+                csnap = ckpt.snapshot_status()
+                csnap["push_seq"] = self._push_seq
+                csnap["push_ts"] = time.time()
+                csnap["push_interval_s"] = self.interval_s
+                self.kv_client.put(
+                    async_ckpt_mod.KV_SCOPE, f"rank{self.rank}",
+                    json.dumps(csnap).encode())
+        except Exception as e:
+            LOG.debug("checkpoint KV push failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

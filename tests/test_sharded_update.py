@@ -323,6 +323,101 @@ def test_elastic_resize_2_to_3_converges(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# elastic resize through the shard checkpoint (utils/async_ckpt.py):
+# a preempted world's shards restore into a different world bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("w_from,w_to", [(2, 3), (3, 2)])
+def test_restore_after_resize_from_shard_checkpoint(tmp_path, monkeypatch,
+                                                    w_from, w_to):
+    """N→M restore: each rank of the old world flushes its shard, the new
+    world reassembles the full state by re-planning the SAVED layout and
+    re-slicing through load_full_state — and the continued trajectory
+    stays bitwise-equal to the replicated baseline (grow and shrink)."""
+    from horovod_tpu.utils import async_ckpt
+
+    opt = optax.adam(1e-3)
+    params = _params()
+    monkeypatch.setenv(env_schema.HOROVOD_ELASTIC_GEN, "0")
+    engines = sharded_mod.make_simulated_engines(opt, w_from)
+    states = [e.init(params) for e in engines]
+    rep_step = _rep_step_fn(opt)
+    rp, rs = params, opt.init(params)
+    sp = params
+    for step in range(3):
+        gs = _grads(params, w_from, step)
+        sp, states = sharded_mod.simulated_step(engines, sp, gs, states)
+        rp, rs = rep_step(rp, gs, rs)
+    # the durable artifact a preemption leaves behind: every rank's own
+    # shard + the replicated leaves (params) on rank 0
+    ckpts = [async_ckpt.AsyncCheckpointer(rank=r, world=w_from,
+                                          directory=str(tmp_path))
+             for r in range(w_from)]
+    try:
+        for r, c in enumerate(ckpts):
+            assert c.snapshot(
+                2, states[r],
+                replicated={"params": sp} if r == 0 else None,
+                layout=engines[r].layout)
+            assert c.flush(deadline_s=10.0)
+    finally:
+        for c in ckpts:
+            c.stop()
+    # --- resize: generation bump, new world restores from disk ----------
+    monkeypatch.setenv(env_schema.HOROVOD_ELASTIC_GEN, "1")
+    sharded_mod.notify_reshard()
+    engines2 = sharded_mod.make_simulated_engines(opt, w_to)
+    states2, restored_params = [], None
+    for e in engines2:
+        e.ensure_layout(sp)
+        manifest, state, replicated = async_ckpt.restore_sharded(
+            str(tmp_path), sp, e)
+        assert manifest["step"] == 2 and manifest["world"] == w_from
+        states2.append(state)
+        if replicated is not None:
+            restored_params = replicated["params"]
+    assert engines2[0].layout.generation == 1
+    # params travelled in rank 0's replicated leaves, bitwise
+    for a, b in zip(jax.tree.leaves(restored_params), jax.tree.leaves(sp)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    sp = restored_params
+    for step in range(3, 6):
+        gs = _grads(params, w_to, step)
+        sp, states2 = sharded_mod.simulated_step(engines2, sp, gs, states2)
+        rp, rs = rep_step(rp, gs, rs)
+    for a, b in zip(jax.tree.leaves(sp), jax.tree.leaves(rp)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"post-restore ({w_from}->{w_to}) divergence from the "
+            "replicated baseline")
+
+
+def test_restore_refuses_changed_layout_threshold(tmp_path, monkeypatch):
+    """The layout digest is load-bearing: a min_shard_elems change since
+    the save must refuse the restore, never silently mis-slice."""
+    from horovod_tpu.utils import async_ckpt
+
+    opt = optax.adam(1e-3)
+    params = _params()
+    monkeypatch.setenv(env_schema.HOROVOD_ELASTIC_GEN, "0")
+    engines = sharded_mod.make_simulated_engines(opt, 2)
+    states = [e.init(params) for e in engines]
+    ckpts = [async_ckpt.AsyncCheckpointer(rank=r, world=2,
+                                          directory=str(tmp_path))
+             for r in range(2)]
+    try:
+        for r, c in enumerate(ckpts):
+            assert c.snapshot(0, states[r], layout=engines[r].layout)
+            assert c.flush(deadline_s=10.0)
+    finally:
+        for c in ckpts:
+            c.stop()
+    manifest, payloads = async_ckpt.load_shards(str(tmp_path))
+    with pytest.raises(async_ckpt.CheckpointError, match="digest"):
+        async_ckpt.assemble_full_state(manifest, payloads, params,
+                                       min_shard_elems=2 ** 10)
+
+
+# ---------------------------------------------------------------------------
 # satellite 6: plan signatures carry the elastic generation
 # ---------------------------------------------------------------------------
 
