@@ -496,6 +496,10 @@ def main():
     extras["perf_exposed_comm_frac"] = pstats.get("exposed_comm_frac")
     extras["perf_negotiate_p95_ms"] = pstats.get("negotiate_p95_ms")
     extras["perf_step_wire_bytes"] = pstats.get("step_wire_bytes")
+    # residual per-step Python outside negotiate+dispatch — the share the
+    # megaplan replay drives toward ≈0 (docs/performance.md "Whole-step
+    # replay"); None while the ledger is off
+    extras["perf_host_overhead_ms"] = pstats.get("host_overhead_p50_ms")
     # Control-plane scale-out telemetry (docs/scaling.md). Single-process
     # benches have no rendezvous controller at all — every field is None
     # then, and negotiation_format is None/"v1" whenever the hierarchy
@@ -555,6 +559,18 @@ def main():
         extras["anatomy_top_entity"] = None
         extras["anatomy_overlap_headroom_s"] = None
         extras["anatomy_replay_headroom_s"] = None
+    # Whole-step megaplan capture/replay when HOROVOD_MEGAPLAN is on
+    # (docs/performance.md "Whole-step replay"). Same None-when-off
+    # convention: with the flag unset no manager exists, so both read
+    # None — the driver's trend tooling can tell "replay off" from
+    # "armed but never captured" (hit rate None) and "replaying" (1.0).
+    mprep = hvd.megaplan_report()
+    if mprep.get("enabled"):
+        extras["megaplan_replay_hit_rate"] = mprep.get("replay_hit_rate")
+        extras["megaplan_capture_rounds"] = mprep.get("capture_rounds")
+    else:
+        extras["megaplan_replay_hit_rate"] = None
+        extras["megaplan_capture_rounds"] = None
     # Async-checkpoint write/restore costs when HOROVOD_ASYNC_CKPT is on
     # (docs/fault_tolerance.md "Surviving preemption"). Same
     # None-when-off convention as the other observability extras.

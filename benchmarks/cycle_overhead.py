@@ -200,6 +200,87 @@ def measure(plans_enabled: bool, cycles: int = 50, warmup: int = 5) -> dict:
                             warmup=warmup, plans_enabled=plans_enabled)
 
 
+def measure_replay(workload: str = "dense_many_small", cycles: int = 50,
+                   warmup: int = None, stable_rounds: int = 5) -> dict:
+    """Drive ``workload`` with whole-step megaplan replay on
+    (HOROVOD_MEGAPLAN=1, ops/megaplan.py) and the perf ledger attached,
+    so the timed window measures the Python-free steady state: after
+    ``stable_rounds`` identical warmup cycles the runtime captures the
+    step's chunk schedule and every timed cycle replays it through one
+    chained dispatch. Returns the replay-path cycle stats plus the
+    steady-state ``negotiate`` / ``host_overhead`` phase shares from the
+    ledger's decomposition — the ≈0 numbers
+    benchmarks/megaplan_budgets.json gates — and the manager's capture /
+    hit-rate counters. Restores the manager-less, ledger-less process
+    state on exit."""
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.ops import megaplan as megaplan_mod
+    from horovod_tpu.ops.queue import TensorEntry
+    from horovod_tpu.utils import perfledger as perfledger_mod
+
+    if warmup is None:
+        # stability window + the capture cycle + slack before timing
+        warmup = stable_rounds + 5
+    os.environ[env_schema.HOROVOD_MEGAPLAN] = "1"
+    os.environ[env_schema.HOROVOD_MEGAPLAN_STABLE_ROUNDS] = str(stable_rounds)
+    os.environ[env_schema.HOROVOD_PERFLEDGER] = "1"
+    megaplan_mod.reset_manager()
+    perfledger_mod.reset_ledger()
+    try:
+        mgr = megaplan_mod.init_manager(rank=0)
+        perfledger_mod.init_ledger(rank=0)
+        # built AFTER both inits: the runtime resolves the manager and
+        # ledger handles once at construction
+        rt, _cfg = _runtime(True)
+        arrays = _arrays(workload)
+
+        def one_cycle():
+            handles = []
+            for i, a in enumerate(arrays):
+                handles.append(rt.enqueue(TensorEntry(
+                    name=f"cycle_overhead.{i}", op="allreduce", tensor=a)))
+            t0 = time.perf_counter()
+            rt.run_cycle()
+            dt = time.perf_counter() - t0
+            for h in handles:
+                rt.handles.wait(h)
+            return dt
+
+        for _ in range(warmup):
+            one_cycle()
+        led = perfledger_mod.get_ledger()
+        n0 = len(led.records())
+        replays0 = mgr.replays
+        times = [one_cycle() for _ in range(cycles)]
+        recs = led.records()[n0:]
+        phases = led.phase_summary(recs)
+        stats = led.stats(recs)
+        replayed = mgr.replays - replays0
+        report = mgr.report()
+    finally:
+        for k in (env_schema.HOROVOD_MEGAPLAN,
+                  env_schema.HOROVOD_MEGAPLAN_STABLE_ROUNDS,
+                  env_schema.HOROVOD_PERFLEDGER):
+            os.environ.pop(k, None)
+        megaplan_mod.reset_manager()
+        perfledger_mod.reset_ledger()
+    return {
+        "workload": workload,
+        "cycles": cycles,
+        "tensors_per_cycle": len(arrays),
+        "dispatch_ms_median": round(statistics.median(times) * 1e3, 4),
+        "dispatch_ms_mean": round(statistics.fmean(times) * 1e3, 4),
+        "captures": report["captures"],
+        "capture_rounds": report["capture_rounds"],
+        "replayed_cycles": replayed,
+        "replay_hit_rate": report["replay_hit_rate"],
+        "negotiate_share": phases.get("negotiate", {}).get("share", 0.0),
+        "host_overhead_share": phases.get("host_overhead",
+                                          {}).get("share", 0.0),
+        "host_overhead_p95_ms": stats.get("host_overhead_p95_ms", 0.0),
+    }
+
+
 def compare_workload(workload: str, cycles: int = 50,
                      warmup: int = 5, reps: int = 3) -> dict:
     """Hand-tuned grid + autotuned run for one workload; the acceptance
@@ -249,6 +330,40 @@ def main() -> int:
         out["legacy_over_fast"] = round(
             legacy["dispatch_ms_median"] / fast["dispatch_ms_median"], 2)
     out["workloads"] = {wl: compare_workload(wl) for wl in WORKLOADS}
+    # whole-step replay vs the per-chunk fast path, all three workloads
+    # (docs/performance.md "Whole-step replay"): the megaplan guard's
+    # headline value is the WORST workload's steady-state
+    # negotiate+host_overhead share — the ≈0 the megaplan promises
+    out["megaplan"] = {}
+    for wl in WORKLOADS:
+        fast = measure_workload(wl)
+        rep = measure_replay(wl)
+        row = {"fastpath": fast, "replay": rep}
+        if fast["dispatch_ms_median"] > 0:
+            row["replay_over_fastpath"] = round(
+                rep["dispatch_ms_median"] / fast["dispatch_ms_median"], 4)
+        out["megaplan"][wl] = row
+    mp_rows = out["megaplan"]
+    out["megaplan_guard"] = {
+        "bench": "cycle_overhead_megaplan",
+        "metric": "megaplan_worst_steady_state_share",
+        "value": max(r["replay"]["negotiate_share"]
+                     + r["replay"]["host_overhead_share"]
+                     for r in mp_rows.values()),
+        "extras": dict(
+            {f"{wl}_negotiate_share": r["replay"]["negotiate_share"]
+             for wl, r in mp_rows.items()},
+            **{f"{wl}_host_overhead_share":
+               r["replay"]["host_overhead_share"]
+               for wl, r in mp_rows.items()},
+            worst_replay_hit_rate=min(
+                r["replay"]["replay_hit_rate"] or 0.0
+                for r in mp_rows.values()),
+            worst_host_overhead_p95_ms=max(
+                r["replay"]["host_overhead_p95_ms"]
+                for r in mp_rows.values()),
+        ),
+    }
     ratios = [w["autotuned_over_best"] for w in out["workloads"].values()
               if w["autotuned_over_best"]]
     # benchguard-compatible result: the headline value is the WORST

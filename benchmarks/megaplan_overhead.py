@@ -1,0 +1,74 @@
+"""Megaplan capture/replay machinery overhead on the cycle loop (CPU).
+
+Enforces the zero-cost contract of horovod_tpu/ops/megaplan.py: with
+``HOROVOD_MEGAPLAN`` unset no manager exists and ``run_cycle()`` pays
+one ``is None`` check, so the megaplan-off build must sit inside
+measurement noise of the pre-megaplan baseline (the ISSUE 18 A/A
+acceptance gate: within 2%, checked against
+benchmarks/megaplan_budgets.json via tools/benchguard) — and the
+megaplan-ON build must be *faster or equal*, never slower: after the
+stability window the measured cycles replay the captured whole-step
+schedule instead of re-grouping and re-dispatching per chunk.
+
+Reuses the cycle_overhead.py harness (same synthetic 20-tensor fused
+workload, same inline ``run_cycle()`` timing) through the shared A/A
+harness in _common.py; the only variable here is the process manager's
+presence.
+
+Run directly for a JSON line:
+
+    JAX_PLATFORMS=cpu python benchmarks/megaplan_overhead.py
+
+or import ``measure_megaplan()`` (the tier-1 smoke test in
+tests/test_megaplan.py does, with small cycle counts and a loose bound,
+so a hot-path regression surfaces in CI rather than on a chip window).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
+    sys.path.insert(1, _HERE)
+
+import _common  # noqa: E402  (benchmarks/ sibling)
+import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
+
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
+
+
+def measure_megaplan(megaplan_on: bool, cycles: int = 50,
+                     warmup: int = 5) -> dict:
+    """cycle_overhead.measure (plans enabled) with the process megaplan
+    manager toggled for the runtime under test. The ON config uses
+    ``measure_replay`` so its warmup covers the stability window and the
+    timed cycles ride the captured schedule. Restores the manager-less
+    state on exit so callers / later tests see the default."""
+    from horovod_tpu.ops import megaplan as megaplan_mod
+
+    if megaplan_on:
+        # measure_replay owns the env + manager lifecycle itself
+        out = cycle_overhead.measure_replay("dense_many_small",
+                                            cycles=cycles)
+    else:
+        megaplan_mod.reset_manager()
+        out = cycle_overhead.measure(plans_enabled=True, cycles=cycles,
+                                     warmup=warmup)
+    out["megaplan_on"] = megaplan_on
+    return out
+
+
+def main() -> int:
+    # Two megaplan-off configs establish the A/A noise floor on this
+    # host; megaplan-off must sit within that floor (+ margin) of the
+    # baseline, because with the manager None the two runs execute
+    # identical code. Interleaving/pairing rationale lives in
+    # _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_megaplan, "megaplan")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

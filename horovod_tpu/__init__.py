@@ -157,6 +157,18 @@ def anatomy_report() -> dict:
     return _anatomy.report()
 
 
+def megaplan_report() -> dict:
+    """This rank's whole-step replay status (ops/megaplan.py): capture
+    and replay counters, the replay hit rate over post-capture cycles,
+    per-reason invalidation counts, the stability threshold, and the
+    live plan's shape (tensors/chunks/bytes) while one is captured.
+    ``{"enabled": False}`` unless HOROVOD_MEGAPLAN was set at init
+    (docs/performance.md, "Whole-step replay")."""
+    from .ops import megaplan as _megaplan
+
+    return _megaplan.report()
+
+
 def checkpoint_report() -> dict:
     """This rank's async-checkpoint status (utils/async_ckpt.py): the
     checkpoint directory, newest durably committed step, last

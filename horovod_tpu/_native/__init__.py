@@ -306,6 +306,47 @@ class StagingRing:
             self._used = [False] * slots
 
 
+def chain_dispatch(buffer: "FusionBuffer", steps):
+    """Megaplan steady-state execution: run a captured whole-step chunk
+    schedule as ONE chained dispatch through the staging ring.
+
+    ``steps`` is the prebuilt schedule — ``(plan, arrays, on_device)``
+    per chunk, in captured order, where ``plan`` is a compiled
+    ``collectives.FusedChunkPlan``. Host chunks stage through a leased
+    ring slot (native parallel memcpy when the core is built — the
+    mandatory numpy fallback rides ``_pack_into``) and the lease retires
+    on the chunk's first output token, exactly the per-chunk contract of
+    ``ops/queue.py``; device chunks launch their compiled program
+    directly. No negotiation, no grouping, no plan lookup — the per-step
+    Python the megaplan eliminates.
+
+    Returns ``(outs, exc)``: ``outs`` holds the per-chunk output lists
+    for every chunk that fully dispatched; ``exc`` is the failure that
+    stopped the chain (None on success). A mid-chain failure retires the
+    failing chunk's lease with ``None`` (the ring is never left torn)
+    and stops — the caller fails the remaining entries and degrades to
+    negotiated mode."""
+    outs = []
+    for plan, arrays, on_device in steps:
+        try:
+            if on_device:
+                outs.append(plan.execute(arrays))
+                continue
+            flat, lease = buffer.pack_leased(arrays)
+            try:
+                parts = plan.execute(flat)
+            except Exception:
+                if lease is not None:
+                    lease.retire(None)
+                raise
+            if lease is not None:
+                lease.retire(parts[0])
+            outs.append(parts)
+        except Exception as exc:
+            return outs, exc
+    return outs, None
+
+
 class FusionBuffer:
     """Fusion pack/unpack helper (reference fusion_buffer_manager.h:40 +
     the MemcpyIn/Out pair, collective_operations.h:65-88): batched,

@@ -291,6 +291,13 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
 
         anatomy_mod.init_profiler(rank=_ctx.global_set.cross_rank)
 
+        # megaplan capture/replay manager, same placement rationale: the
+        # runtime resolves the manager handle once at build time (and the
+        # coordinator reads the same env gate in its own __init__)
+        from ..ops import megaplan as megaplan_mod
+
+        megaplan_mod.init_manager(rank=_ctx.global_set.cross_rank)
+
         # async shard checkpointer AFTER _start_diag(): its SIGTERM
         # handler must capture diag's as the chain target, so a
         # preemption flushes the in-flight snapshot first and dumps the

@@ -154,6 +154,14 @@ HOROVOD_PLAN_CACHE_MAX_BYTES = "HOROVOD_PLAN_CACHE_MAX_BYTES"
 # record-ring capacity
 HOROVOD_ANATOMY = "HOROVOD_ANATOMY"
 HOROVOD_ANATOMY_BUFFER = "HOROVOD_ANATOMY_BUFFER"
+# whole-step megaplan capture & replay (ops/megaplan.py;
+# docs/performance.md "Whole-step replay"): master switch, and how many
+# consecutive identical working cycles (the response-cache/SAME_AS_LAST
+# stability signal) must be observed before the full step schedule —
+# negotiated order, chunk grouping, compiled chunk programs — is
+# captured and steady-state cycles replay it with ~one validity check
+HOROVOD_MEGAPLAN = "HOROVOD_MEGAPLAN"
+HOROVOD_MEGAPLAN_STABLE_ROUNDS = "HOROVOD_MEGAPLAN_STABLE_ROUNDS"
 # preemption-tolerant async sharded checkpointing (utils/async_ckpt.py;
 # docs/fault_tolerance.md "Surviving preemption"): master switch, the
 # directory shard checkpoints + manifest land in, and the SIGTERM grace
@@ -301,6 +309,12 @@ class RuntimeConfig:
     # (zero-cost contract: no hvd_anatomy_* series)
     anatomy_enabled: bool = False
     anatomy_buffer: int = 512
+    # whole-step megaplan capture & replay (ops/megaplan.py) — off by
+    # default (zero-cost contract: no hvd_megaplan_* series); the
+    # stable-round count mirrors the reference response cache's
+    # warmup-before-bypass behavior
+    megaplan: bool = False
+    megaplan_stable_rounds: int = 5
     # preemption-tolerant async sharded checkpointing (utils/async_ckpt.py)
     # — off by default (zero-cost contract: no hvd_ckpt_* series);
     # async_ckpt_dir="" resolves to ./horovod_ckpt at init
@@ -384,6 +398,9 @@ class RuntimeConfig:
                                          c.plan_cache_max_bytes)
         c.anatomy_enabled = get_bool(HOROVOD_ANATOMY)
         c.anatomy_buffer = get_int(HOROVOD_ANATOMY_BUFFER, c.anatomy_buffer)
+        c.megaplan = get_bool(HOROVOD_MEGAPLAN)
+        c.megaplan_stable_rounds = get_int(HOROVOD_MEGAPLAN_STABLE_ROUNDS,
+                                           c.megaplan_stable_rounds)
         c.async_ckpt = get_bool(HOROVOD_ASYNC_CKPT)
         c.async_ckpt_dir = get_str(HOROVOD_ASYNC_CKPT_DIR)
         c.preempt_grace_s = get_float(HOROVOD_PREEMPT_GRACE_S,

@@ -435,6 +435,12 @@ def invalidate_fused_plans() -> int:
         from ..utils import flightrec
 
         flightrec.note("plan_cache_invalidated", count=len(stale))
+    # a captured megaplan holds references to the dropped programs: the
+    # whole-step schedule is stale by the same reasoning the chunk plans
+    # are, so it invalidates through the same funnel
+    from . import megaplan as megaplan_mod
+
+    megaplan_mod.invalidate_megaplan("plan_cache")
     return len(stale)
 
 

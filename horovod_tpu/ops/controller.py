@@ -77,6 +77,7 @@ from ..utils import lockcheck
 from ..utils import metrics as metrics_mod
 from ..utils import retry as retry_mod
 from ..utils import tracing as tracing_mod
+from . import megaplan as megaplan_mod
 from . import wire as wire_mod
 
 LOG = logging.getLogger("horovod_tpu")
@@ -193,6 +194,13 @@ class KVController:
 
     on_params = None  # callable(dict) applied at response receipt
 
+    # Megaplan replay lease (ops/megaplan.py): True while the coordinator
+    # granted "mp" on the latest response — every rank has been sending
+    # SAME_AS_LAST markers for the stability window, so whole-step replay
+    # may enter/exit at the same round boundary on every rank. Updated by
+    # _finish_round each round; read by the cycle loop's capture gate.
+    megaplan_lease = False
+
     # After a leader let a member (or its own merge) down, ranks submit
     # flat for this many rounds before re-trying the hierarchy — a dead
     # leader must not cost a fallback timeout every round, and the whole
@@ -289,6 +297,9 @@ class KVController:
         self._last_agg = None
         self._last_channel = "flat"
         self._flat_until = 0
+        # new grouping = new submission channels: a captured whole-step
+        # schedule keyed to the old round topology must not replay
+        megaplan_mod.invalidate_megaplan("hier_group")
 
     def negotiate(self, pending: dict[str, list],
                   joined: bool = False,
@@ -318,6 +329,47 @@ class KVController:
         except Exception:
             self.broken = True
             raise
+        return self._finish_round(resp)
+
+    def lease_round(self) -> dict:
+        """One replay-mode round: the megaplan lease is held, so this
+        process's submission is — by the captured signature's guarantee —
+        identical to last round's, and the round submits the verbatim
+        1-byte SAME_AS_LAST marker without re-serializing anything (the
+        Python-free steady state of docs/performance.md). The response
+        still flows through the full `_finish_round` control path, so
+        params pushes, aborts, cache invalidations and shutdown are never
+        lost in replay mode; the caller re-checks ``megaplan_lease`` (and
+        the megaplan epoch) on return and degrades when the coordinator
+        dropped the grant mid-round. Wire v1 only: the coordinator never
+        grants the lease under the hierarchical v2 wire, whose leaders
+        must still merge member submissions every round."""
+        if self.broken:
+            raise RuntimeError("controller is broken; re-initialize horovod_tpu")
+        r = self.round
+        try:
+            w = self.SAME_AS_LAST
+            if self._tracer is not None:
+                w += json.dumps({"t": self._tracer.aligned_now()}).encode()
+            self.fast_rounds += 1
+            self._m_cache_hit.inc()
+            faults_mod.fault_point("controller.submit")
+            self.client.put(_ctl_scope(r), f"ready/{self.rank}", w)
+            self.bytes_sent += len(w)
+            self._m_wire_bytes.inc(len(w))
+            raw = self._poll_response(r)
+            self.bytes_received += len(raw)
+            resp = self._decode_response(raw)
+        except Exception:
+            self.broken = True
+            raise
+        return self._finish_round(resp)
+
+    def _finish_round(self, resp: dict) -> dict:
+        """Shared response-processing tail of `negotiate` and
+        `lease_round`: the round's control effects (abort, lockstep
+        advance, cache invalidation, lease state, shutdown, tuned params,
+        wire handshake) apply identically in negotiated and replay mode."""
         if resp.get("abort"):
             # coordinator died and fail-fast-closed the round: this
             # controller can never rejoin the lockstep
@@ -332,6 +384,10 @@ class KVController:
         resp.setdefault("errors", {})
         resp.setdefault("sigs", {})
         resp.setdefault("join_done", None)
+        # replay lease: granted (or re-granted) per round; any round the
+        # coordinator does not grant it drops every rank out of replay at
+        # the same boundary
+        self.megaplan_lease = bool(resp.get("mp"))
         if resp.get("shutdown_done"):
             # every rank has requested shutdown: the lockstep is over
             self.broken = True
@@ -761,6 +817,18 @@ class _Coordinator(threading.Thread):
         # adaptive bulk-read target: how many distinct sources closed the
         # last round (size when flat, ~size/k under hierarchy)
         self._expected_sources = size
+        # megaplan replay lease (ops/megaplan.py): consecutive rounds in
+        # which EVERY source rode the SAME_AS_LAST marker and nothing
+        # perturbed the round (errors/join/params/wire upgrade). At the
+        # stability threshold the response grants "mp" — all ranks enter
+        # and exit replay at the same round boundary. 0 disables the
+        # grant entirely (HOROVOD_MEGAPLAN unset).
+        self._mp_rounds = 0
+        if env_schema.get_bool(env_schema.HOROVOD_MEGAPLAN):
+            self._mp_rounds = max(1, env_schema.get_int(
+                env_schema.HOROVOD_MEGAPLAN_STABLE_ROUNDS,
+                megaplan_mod.DEFAULT_STABLE_ROUNDS))
+        self._mp_stable = 0
         # join tracking (reference JoinOp: joined_size / joined ranks,
         # global_state.h:107-111)
         self._joined: set[int] = set()
@@ -842,6 +910,7 @@ class _Coordinator(threading.Thread):
         # and tell workers to resend full payloads next round
         self._last_submission.clear()
         self._arrivals.clear()
+        self._mp_stable = 0  # error-closed round: replay stability over
         self.client.put(_ctl_scope(r), "resp",
                         json.dumps({"ready": [], "errors": errors,
                                     "invalidate": True}).encode())
@@ -874,7 +943,9 @@ class _Coordinator(threading.Thread):
                         t_map = {int(source): float(t)}
                 except (ValueError, TypeError, KeyError):
                     t_map = {}
-            return dict(base, t=t_map)
+            # mk: this source rode the marker fast path this round — the
+            # megaplan stability signal counts all-marker rounds
+            return dict(base, t=t_map, mk=True)
         if raw[:1] == _MAGIC_BYTE:
             if wire_mod.is_aggregate(raw):
                 m = wire_mod.decode_aggregate(raw)
@@ -914,7 +985,7 @@ class _Coordinator(threading.Thread):
                        "sd": {k} if msg.get("sd") else set(),
                        "wv": int(msg.get("wv") or 1)}
         self._last_submission[source] = contrib
-        return dict(contrib, t=t_map)
+        return dict(contrib, t=t_map, mk=False)
 
     def _gather_round(self, r: int) -> Optional[list]:
         """Collect submissions until every rank is covered (a flat source
@@ -1075,6 +1146,24 @@ class _Coordinator(threading.Thread):
                     # confirm in the (still-JSON) response and switch —
                     # any rank without "wv" keeps the whole world on v1
                     resp_dict["wv"] = wire_mod.WIRE_V2
+                if self._mp_rounds:
+                    # megaplan stability: an all-marker, unperturbed round
+                    # extends the streak; anything else (a full payload
+                    # from any rank, an error, a join in flight, a params
+                    # push, the wire handshake, v2 hierarchy) resets it —
+                    # so a lease is only ever granted while every rank is
+                    # demonstrably repeating the identical step. Not under
+                    # wire v2: leaders merge members every round, so there
+                    # is no per-rank marker signal to count.
+                    stable = (not errors and join_done is None
+                              and not self._joined and not self._down
+                              and not self._wire_v2
+                              and "wv" not in resp_dict
+                              and "params" not in resp_dict
+                              and all(c.get("mk") for _, c in contribs))
+                    self._mp_stable = self._mp_stable + 1 if stable else 0
+                    if self._mp_stable >= self._mp_rounds:
+                        resp_dict["mp"] = True
                 if self._resp_enc is not None:
                     raw_resp = self._resp_enc.encode(resp_dict)
                 else:

@@ -1,0 +1,299 @@
+"""Whole-step megaplan capture & replay: the Python-free steady state.
+
+The fused-plan cache (ops/collectives.py) already collapses each chunk
+to one compiled dispatch, but the cycle loop still pays per-step Python
+for negotiation, ordering, grouping and per-chunk plan lookups — the
+`replay_headroom_s` the step-anatomy profiler (utils/anatomy.py)
+measures. This module removes it: when the runtime observes the
+identical named tensor set for ``HOROVOD_MEGAPLAN_STABLE_ROUNDS``
+consecutive working cycles (the same stability the controller's
+response-cache/SAME_AS_LAST wire marker detects), it captures the whole
+step's collective schedule — negotiated order, fused-chunk grouping,
+and the compiled chunk programs from the plan LRU — as one
+epoch-guarded :class:`Megaplan`. Steady-state cycles then replay it
+through ``_native.chain_dispatch`` with ~a single is-valid check.
+
+Validity is epoch-guarded on two axes so correctness never depends on
+replay:
+
+- the **megaplan epoch** (:func:`epoch`), bumped by
+  :func:`invalidate_megaplan` from every autotuner knob setter, plan
+  cache invalidation, and hier-topology change;
+- the **plan epoch** (collectives._plan_epoch, the elastic generation),
+  stamped at capture so an elastic resize invalidates within one cycle.
+
+Any mismatch — epoch, batch signature (names/shapes/dtypes/ops/
+residency), membership (join, pending backlog), or a dropped
+coordinator lease — atomically degrades the cycle back to the
+negotiated path and re-arms capture.
+
+Multi-process entry/exit is round-synchronized by a coordinator
+**lease**: the coordinator counts consecutive all-marker rounds
+(every rank submitted the 1-byte SAME_AS_LAST wire) and grants ``mp``
+on its response; any rank breaking stability (a full payload, an
+error, a join, a params push) drops the lease for everyone in the same
+round (ops/controller.py).
+
+Zero-cost contract (same as utils/anatomy.py, enforced by
+benchmarks/megaplan_overhead.py): with ``HOROVOD_MEGAPLAN`` unset no
+manager exists, ``ops/queue.py`` pays one ``is None`` check per cycle,
+and no ``hvd_megaplan_*`` series is registered — metric handles are
+resolved in ``MegaplanManager.__init__``, lazily at enable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from ..common import env as env_schema
+from ..utils import flightrec as flightrec_mod
+
+DEFAULT_STABLE_ROUNDS = 5
+
+#: Megaplan epoch: bumped by every :func:`invalidate_megaplan` call.
+#: Captured plans stamp the value they were built under; a steady-state
+#: cycle compares one int — the "single is-valid check" of the replay
+#: fast path. Plain int (CPython word-atomic): readers only compare.
+_EPOCH = 0
+
+_MANAGER: Optional["MegaplanManager"] = None
+
+
+def epoch() -> int:
+    return _EPOCH
+
+
+def invalidate_megaplan(reason: str = "invalidation") -> None:
+    """The single invalidation funnel (the ``invalidate_fused_plans()``
+    of whole-step schedules): every autotuner knob setter, plan-cache
+    invalidation, elastic transition and hier-topology change routes
+    here. Bumps the epoch — so a replaying cycle thread fails its next
+    validity check — and drops the captured plan."""
+    global _EPOCH
+    _EPOCH += 1
+    mgr = _MANAGER
+    if mgr is not None:
+        mgr.invalidate(reason)
+
+
+def batch_signature(batch: Sequence[Any]) -> Tuple:
+    """Order-insensitive identity of a drained batch: (name, op, shape,
+    dtype, reduce op, scales, process set, quant, residency) per entry,
+    sorted by name. Replay compares the drained batch's signature to the
+    captured one — a shape, dtype, membership or residency change under
+    a reused name misses instead of executing a stale program."""
+    from . import collectives as C
+
+    rows = []
+    for e in batch:
+        t = e.tensor
+        q = e.quant
+        rows.append((e.name, e.op,
+                     tuple(getattr(t, "shape", ()) or ()),
+                     str(getattr(t, "dtype", "")),
+                     int(e.reduce_op), float(e.prescale_factor),
+                     float(e.postscale_factor),
+                     getattr(e.process_set, "name", None) or "global",
+                     None if q is None else q.signature(),
+                     bool(C.is_device_resident(t))))
+    rows.sort()
+    return tuple(rows)
+
+
+class Megaplan:
+    """One captured whole-step schedule: the ordered chunk dispatch
+    chain plus the validity stamps it was captured under."""
+
+    __slots__ = ("sig", "chunks", "epoch", "plan_epoch", "tensors",
+                 "nbytes")
+
+    def __init__(self, sig: Tuple, chunks: Tuple, epoch: int,
+                 plan_epoch: int):
+        #: batch signature (see :func:`batch_signature`)
+        self.sig = sig
+        #: ordered chunk steps: (names, compiled plan, on_device,
+        #: chunk bytes, dtype) — plan objects are owned references, so a
+        #: later LRU eviction cannot tear a live megaplan
+        self.chunks = chunks
+        self.epoch = epoch
+        self.plan_epoch = plan_epoch
+        self.tensors = sum(len(c[0]) for c in chunks)
+        self.nbytes = sum(int(c[3]) for c in chunks)
+
+
+class MegaplanManager:
+    """Capture/replay state for one runtime (cycle-thread driven).
+
+    The state machine is armed → captured; ``observe()`` counts
+    consecutive identical batch signatures on negotiated working
+    cycles, ``commit()`` installs the captured schedule, and any
+    validity miss or :func:`invalidate_megaplan` call drops it and
+    re-arms. ``invalidate()`` may be called from other threads (elastic
+    driver, autotuner apply path): it only clears references, so the
+    cycle thread observes either the old plan (stale epoch → miss) or
+    None."""
+
+    def __init__(self, rank: int = 0, stable_rounds: Optional[int] = None):
+        self.rank = rank
+        if stable_rounds is None:
+            stable_rounds = env_schema.get_int(
+                env_schema.HOROVOD_MEGAPLAN_STABLE_ROUNDS,
+                DEFAULT_STABLE_ROUNDS)
+        self.stable_rounds = max(1, int(stable_rounds))
+        self.plan: Optional[Megaplan] = None
+        self._last_sig: Optional[Tuple] = None
+        self._stable = 0
+        #: stable cycles observed before the most recent capture
+        self.capture_rounds = 0
+        self.captures = 0
+        self.replays = 0
+        #: post-capture cycles that missed validity (the hit-rate
+        #: denominator together with ``replays``)
+        self.misses = 0
+        self.invalidations = 0
+        from ..utils import metrics as metrics_mod
+
+        reg = metrics_mod.get_registry()
+        self._reg = reg
+        self._m_captures = reg.counter(
+            "hvd_megaplan_captures_total",
+            "whole-step megaplans captured")
+        self._m_replays = reg.counter(
+            "hvd_megaplan_replays_total",
+            "steady-state cycles replayed from a captured megaplan")
+        self._m_active = reg.gauge(
+            "hvd_megaplan_active",
+            "1 while a captured megaplan is live, 0 while armed")
+        self._m_capture_rounds = reg.gauge(
+            "hvd_megaplan_capture_rounds",
+            "stable cycles observed before the most recent capture")
+        # per-reason invalidation handles, lazily cached like the
+        # queue's per-(op, dtype) metric dict
+        self._m_inval: dict = {}
+
+    # -- cycle-thread state machine ------------------------------------
+
+    def observe(self, sig: Tuple) -> bool:
+        """Count stability on a negotiated working cycle; True when the
+        batch has been identical for ``stable_rounds`` consecutive
+        cycles and no plan is live — i.e. THIS cycle should capture."""
+        if sig == self._last_sig:
+            self._stable += 1
+        else:
+            self._last_sig = sig
+            self._stable = 1
+        return self.plan is None and self._stable >= self.stable_rounds
+
+    def commit(self, plan: Megaplan) -> None:
+        """Install a captured schedule and note the event."""
+        self.plan = plan
+        self.captures += 1
+        self.capture_rounds = self._stable
+        self._m_captures.inc()
+        self._m_active.set(1)
+        self._m_capture_rounds.set(self.capture_rounds)
+        flightrec_mod.note("megaplan", event="captured",
+                           tensors=plan.tensors, chunks=len(plan.chunks),
+                           bytes=plan.nbytes, rounds=self._stable)
+
+    def abort_capture(self) -> None:
+        """A capture attempt failed (injected fault, partial coverage):
+        restart the stability count so re-capture needs a fresh
+        stable window."""
+        self._stable = 0
+        self._last_sig = None
+
+    def note_replay(self) -> None:
+        self.replays += 1
+        self._m_replays.inc()
+
+    def invalidate(self, reason: str = "invalidation") -> None:
+        """Drop the captured schedule (if any) and re-arm capture.
+        Callable from any thread; counted only when a plan was live so
+        repeated invalidations of an armed manager stay silent."""
+        had = self.plan is not None
+        self.plan = None
+        self._stable = 0
+        self._last_sig = None
+        if not had:
+            return
+        self.invalidations += 1
+        self.misses += 1
+        m = self._m_inval.get(reason)
+        if m is None:
+            m = self._m_inval[reason] = self._reg.counter(
+                "hvd_megaplan_invalidations_total",
+                "captured megaplans dropped back to negotiated mode",
+                reason=reason)
+        m.inc()
+        self._m_active.set(0)
+        flightrec_mod.note("megaplan", event="invalidated", reason=reason)
+
+    # -- readers --------------------------------------------------------
+
+    def replay_hit_rate(self) -> Optional[float]:
+        """Replayed fraction of post-capture steady-state cycles; None
+        before the first capture attempt resolves."""
+        total = self.replays + self.misses
+        if total == 0:
+            return None
+        return self.replays / total
+
+    def report(self) -> dict:
+        plan = self.plan
+        out = {"enabled": True, "active": plan is not None,
+               "stable_rounds": self.stable_rounds,
+               "captures": self.captures, "replays": self.replays,
+               "misses": self.misses,
+               "invalidations": self.invalidations,
+               "capture_rounds": self.capture_rounds,
+               "replay_hit_rate": self.replay_hit_rate(),
+               "epoch": _EPOCH}
+        if plan is not None:
+            out["plan"] = {"tensors": plan.tensors,
+                           "chunks": len(plan.chunks),
+                           "bytes": plan.nbytes,
+                           "epoch": plan.epoch,
+                           "plan_epoch": plan.plan_epoch}
+        return out
+
+
+# --------------------------------------------------------------------------
+# Process-global manager (the utils/anatomy.py module-trio pattern):
+# get_manager() returns None when HOROVOD_MEGAPLAN is off, and the cycle
+# loop costs exactly one is-None check in that state.
+# --------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return env_schema.get_bool(env_schema.HOROVOD_MEGAPLAN)
+
+
+def get_manager() -> Optional[MegaplanManager]:
+    return _MANAGER
+
+
+def init_manager(rank: int = 0) -> Optional[MegaplanManager]:
+    """Create the process manager when ``HOROVOD_MEGAPLAN`` is set
+    (idempotent); no-op returning None when off."""
+    global _MANAGER
+    if not enabled():
+        return _MANAGER
+    if _MANAGER is None:
+        _MANAGER = MegaplanManager(rank=rank)
+    return _MANAGER
+
+
+def reset_manager() -> None:
+    """Drop the process manager (test/bench helper)."""
+    global _MANAGER
+    _MANAGER = None
+
+
+def report() -> dict:
+    """``hvd.megaplan_report()`` body: ``{"enabled": False}`` when off,
+    else capture/replay counters, hit rate and the live plan's shape."""
+    mgr = _MANAGER
+    if mgr is None:
+        return {"enabled": False}
+    return mgr.report()

@@ -54,6 +54,7 @@ from ..utils import perfledger as perfledger_mod
 from ..utils import tracing as tracing_mod
 from . import collectives as C
 from . import compression as compression_mod
+from . import megaplan as megaplan_mod
 
 LOG = logging.getLogger("horovod_tpu")
 
@@ -268,6 +269,16 @@ class BackgroundRuntime:
         # (benchmarks/anatomy_overhead.py): a None handle keeps every
         # dispatch hook at one is-None check
         self.profiler = anatomy_mod.get_profiler()
+        # whole-step megaplan capture & replay (ops/megaplan.py), same
+        # resolved-once contract (benchmarks/megaplan_overhead.py): a
+        # None handle keeps run_cycle at one is-None check per cycle
+        self._mp = megaplan_mod.get_manager()
+        # chunk schedule being recorded this cycle (cycle-thread-only
+        # scratch): a list while a capture is in progress, None otherwise
+        self._mp_capture: Optional[list] = None
+        from .._native import chain_dispatch
+
+        self._chain_dispatch = chain_dispatch
         # per-cycle scratch the ledger hooks accumulate into (cycle
         # thread only): execute-window seconds and the round's worst
         # coordinator straggler verdict
@@ -353,6 +364,15 @@ class BackgroundRuntime:
         the affected cached state (plans / staging ring / hier channels)."""
         try:
             knobs = self._validate_tuned_params(p)
+            if knobs:
+                # one funnel for ALL tuned knobs (the autotuner
+                # handshake): a knob landing mid-replay must never let a
+                # stale whole-step schedule execute, even for knobs that
+                # do not move chunk boundaries — the epoch bump makes
+                # the replaying cycle thread miss its next validity
+                # check (the individual setters below additionally
+                # invalidate through invalidate_fused_plans)
+                megaplan_mod.invalidate_megaplan("tuned_params")
             if "fusion" in knobs:
                 self.set_fusion_threshold(knobs["fusion"])
             if "cycle" in knobs:
@@ -392,6 +412,9 @@ class BackgroundRuntime:
             self.fusion_buffer.set_slots(slots)
         except Exception:
             LOG.exception("staging ring slot resize failed")
+        # a captured megaplan chains dispatches through the ring; a
+        # depth change mid-replay re-captures under the new topology
+        megaplan_mod.invalidate_megaplan("ring_slots")
 
     def set_plan_chunk_tensors(self, n: int):
         """Adopt a new per-chunk tensor cap. Chunk boundaries move, so
@@ -629,6 +652,14 @@ class BackgroundRuntime:
                     entry = self._pending.pop(n, None)
                     if entry is not None:
                         self._finish(entry, None, err)
+        # steady-state replay: a live megaplan short-circuits the whole
+        # negotiated path to ~one validity check + one chained dispatch
+        # (docs/performance.md "Whole-step replay"); a miss invalidates
+        # and falls through to the negotiated path below
+        mp = self._mp
+        if mp is not None and batch and mp.plan is not None:
+            if self._megaplan_cycle(batch, cycle_t0, timed):
+                return
         if self.controller is not None:
             _pt = time.perf_counter() if timed else 0.0
             batch = self._negotiate(batch)
@@ -645,6 +676,30 @@ class BackgroundRuntime:
             self._m_cycles_idle.inc()
             return
         self._m_cycles_work.inc()
+        # megaplan stability: count consecutive identical batch
+        # signatures on negotiated working cycles; at the stability
+        # threshold, THIS cycle's dispatch records the chunk schedule
+        # (the capture list filled by _run_fused_allreduce) — only when
+        # the whole step is plan-replayable and, multi-process, the
+        # coordinator granted the replay lease at the same boundary
+        cap_sig = None
+        if mp is not None:
+            cap_sig = megaplan_mod.batch_signature(batch)
+            if (mp.observe(cap_sig) and self._plans_enabled
+                    and not self._pending and not self.joined
+                    and (self.controller is None
+                         or self.controller.megaplan_lease)):
+                self._mp_capture = []
+        t_disp = self._dispatch_batch(batch, timed)
+        if self._mp_capture is not None:
+            self._megaplan_commit(cap_sig, batch)
+        self._finish_cycle(batch, cycle_t0, timed, t_neg, t_disp)
+
+    def _dispatch_batch(self, batch: list[TensorEntry], timed: bool) -> float:
+        """Group a ready batch into fusable chunks vs singletons and
+        dispatch them; returns the dispatch-window seconds (0.0 when
+        untimed). Shared by the negotiated path and the megaplan
+        lease-drop fallback."""
         # split into fusable allreduce groups vs singletons
         fusable: dict[tuple, list[TensorEntry]] = {}
         singles: list[TensorEntry] = []
@@ -670,20 +725,31 @@ class BackgroundRuntime:
                 fusable.setdefault(key, []).append(e)
             else:
                 singles.append(e)
+        if singles:
+            # singletons dispatch eagerly, outside any compiled chunk
+            # plan: a step containing one is not whole-step replayable
+            self._mp_capture = None
         if timed:
             _pt = time.perf_counter()
         for key, group in fusable.items():
             self._run_fused_allreduce(group)
         for e in singles:
             self._run_single(e)
-        if timed:
-            t_disp = time.perf_counter() - _pt
+        return time.perf_counter() - _pt if timed else 0.0
+
+    def _finish_cycle(self, batch: list[TensorEntry], cycle_t0: float,
+                      timed: bool, t_neg: float, t_disp: float):
+        """Working-cycle epilogue: wall histogram, perf-ledger and
+        anatomy step records, autotune hooks. Shared by the negotiated
+        path and megaplan replay so attribution stays uniform."""
         wall = time.perf_counter() - cycle_t0
         self._m_cycle.observe(wall)
+        led = self.ledger
         if led is not None:
             led.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
                             exec_s=self._perf_exec_s, tensors=len(batch),
                             straggler=self._perf_strag)
+        profiler = self.profiler
         if profiler is not None:
             profiler.record_step(wall, negotiate_s=t_neg, dispatch_s=t_disp,
                                  tensors=len(batch),
@@ -703,6 +769,192 @@ class BackgroundRuntime:
                     at.sample()
                 except Exception:
                     LOG.exception("autotune sample failed")
+
+    def _megaplan_commit(self, sig, batch: list[TensorEntry]):
+        """Install the chunk schedule recorded during this cycle's
+        dispatch as the live megaplan — only when every batch entry rode
+        a compiled chunk plan (singles/quant/legacy/failed chunks abort
+        the capture list). The ``megaplan.capture`` fault site lets
+        chaos tests kill a capture at the commit boundary: an injected
+        failure re-arms cleanly, never installs a torn schedule."""
+        cap, self._mp_capture = self._mp_capture, None
+        mp = self._mp
+        if not cap or sum(len(c[0]) for c in cap) != len(batch):
+            mp.abort_capture()
+            return
+        try:
+            faults_mod.fault_point("megaplan.capture")
+            mp.commit(megaplan_mod.Megaplan(
+                sig=sig, chunks=tuple(cap), epoch=megaplan_mod.epoch(),
+                plan_epoch=C._plan_epoch()))
+        except Exception as exc:
+            LOG.warning("megaplan capture aborted: %s", exc)
+            mp.abort_capture()
+
+    def _megaplan_cycle(self, batch: list[TensorEntry], cycle_t0: float,
+                        timed: bool) -> bool:
+        """One steady-state cycle against the captured megaplan.
+
+        Returns True when the cycle was fully handled — replayed, or
+        (multi-process) degraded-but-dispatched after a lease drop whose
+        round was already consumed. Returns False on a validity miss
+        BEFORE any round or dispatch, so the normal negotiated path runs
+        this cycle from scratch; every miss invalidates and re-arms.
+        """
+        mp = self._mp
+        plan = mp.plan
+        # the ~single is-valid check of the replay fast path: two epoch
+        # ints (knob/autotune invalidations + the elastic generation),
+        # membership, then the batch signature
+        if (plan.epoch != megaplan_mod.epoch()
+                or plan.plan_epoch != C._plan_epoch()):
+            mp.invalidate("epoch")
+            return False
+        if self.joined or self._pending:
+            mp.invalidate("membership")
+            return False
+        if megaplan_mod.batch_signature(batch) != plan.sig:
+            mp.invalidate("signature")
+            return False
+        ctl = self.controller
+        if ctl is not None and not ctl.megaplan_lease:
+            # the coordinator withheld the grant on the previous response
+            # (another rank broke stability): negotiate this round fully
+            mp.invalidate("lease")
+            return False
+        try:
+            # chaos site: fires BEFORE any ring lease or dispatch, so an
+            # injected mid-replay invalidation degrades to negotiated
+            # mode with zero leaked spans and no torn ring state
+            faults_mod.fault_point("megaplan.replay")
+        except Exception as exc:
+            LOG.warning("megaplan replay fault: %s", exc)
+            mp.invalidate("fault")
+            return False
+        t_neg = 0.0
+        if ctl is not None:
+            # replay-mode lease round: the 1-byte SAME_AS_LAST marker
+            # keeps the lockstep advancing (and the coordinator's
+            # stability count alive) without re-serializing the
+            # submission; the full control path (params/abort/shutdown)
+            # still applies — see KVController.lease_round
+            _pt = time.perf_counter() if timed else 0.0
+            if self.watchdog is not None:
+                self.watchdog.enter("negotiate")
+            try:
+                resp = ctl.lease_round()
+            except Exception as exc:
+                if self._stop.is_set():
+                    err: Exception = HorovodInternalError(
+                        "Horovod has been shut down")
+                else:
+                    LOG.error("lease round failed: %s", exc)
+                    err = HorovodInternalError(
+                        f"controller negotiation failed: {exc}")
+                for e in batch:
+                    self._finish(e, None, err)
+                mp.invalidate("controller")
+                return True
+            finally:
+                if self.watchdog is not None:
+                    self.watchdog.exit_phase("negotiate")
+            if timed:
+                t_neg = time.perf_counter() - _pt
+            if (not ctl.megaplan_lease or resp.get("errors")
+                    or resp.get("join_done") is not None
+                    or plan.epoch != megaplan_mod.epoch()):
+                # the lease broke mid-round (another rank's set changed,
+                # a params push bumped the epoch, a rank joined): the
+                # round IS consumed — our cached submission was merged —
+                # so process its response like a negotiated round and
+                # dispatch whatever it released; re-negotiating would
+                # desync the lockstep
+                mp.invalidate("lease")
+                for e in batch:
+                    self._pending[self._wire_name(e)] = e
+                out = self._process_response(resp)
+                if not out:
+                    self._m_cycles_idle.inc()
+                    return True
+                self._m_cycles_work.inc()
+                t_disp = self._dispatch_batch(out, timed)
+                self._finish_cycle(out, cycle_t0, timed, t_neg, t_disp)
+                return True
+        # replay: one chained dispatch through the staging ring
+        # (_native.chain_dispatch) over the captured schedule
+        self._m_cycles_work.inc()
+        by = {e.name: e for e in batch}
+        if self.tracer is not None:
+            disp0 = time.time()
+            for e in batch:
+                if e.span is not None:
+                    e.span.t[tracing_mod.T_DISPATCH_START] = disp0
+        _dt0 = time.perf_counter()
+        steps = []
+        for names, cplan, on_dev, nbytes, dtype in plan.chunks:
+            entries = [by[n] for n in names]
+            if on_dev:
+                arrs = [e.tensor for e in entries]
+            else:
+                arrs = [np.asarray(e.tensor) for e in entries]
+            steps.append((cplan, arrs, on_dev))
+        outs, exc = self._chain_dispatch(self.fusion_buffer, steps)
+        exec_s = time.perf_counter() - _dt0
+        if timed:
+            self._perf_exec_s += exec_s
+        disp1 = time.time() if self.tracer is not None else 0.0
+        done = 0
+        all_names: list = []
+        total_bytes = 0
+        last_token = None
+        for i, parts in enumerate(outs):
+            names, cplan, on_dev, nbytes, dtype = plan.chunks[i]
+            all_names.extend(names)
+            total_bytes += nbytes
+            if parts:
+                last_token = parts[0]
+            m_bytes, m_lat, m_ops = self._op_metrics("allreduce", dtype)
+            m_bytes.inc(nbytes)
+            m_ops.inc()
+            m_lat.observe(exec_s)
+            self._m_fusion_batch.observe(len(names))
+            self._m_fused_bytes.observe(nbytes)
+            for n, p in zip(names, parts):
+                e = by[n]
+                if e.span is not None:
+                    e.span.t[tracing_mod.T_DISPATCH_END] = disp1
+                    e.span.chunk_bytes = nbytes
+                    e.span.chunk_tensors = len(names)
+                self._finish(e, p)
+            done += len(names)
+        self.bytes_processed += total_bytes
+        # dispatch-phase window ends after completion bookkeeping so the
+        # ledger attribution matches the negotiated path, whose timed
+        # window also covers per-chunk metrics and entry finishing
+        t_disp = time.perf_counter() - _dt0
+        if exc is not None:
+            # mid-chain failure: chain_dispatch already retired the
+            # failing chunk's lease, so the ring is clean — fail every
+            # remaining entry through the single terminal (zero leaked
+            # spans) and degrade to negotiated mode
+            self._m_op_errors.inc(len(batch) - done)
+            err = HorovodInternalError(f"megaplan replay failed: {exc}")
+            for names, _cplan, _od, _nb, _dt in plan.chunks[len(outs):]:
+                for n in names:
+                    self._finish(by[n], None, err)
+            failing = plan.chunks[len(outs)]
+            for n in failing[0]:
+                self._finish(by[n], None, err)
+            mp.invalidate("dispatch")
+            self._finish_cycle(batch, cycle_t0, timed, t_neg, t_disp)
+            return True
+        mp.note_replay()
+        if self.profiler is not None:
+            self.profiler.note_megaplan(
+                all_names, total_bytes, len(batch), exec_s,
+                token=last_token, t0_pc=_dt0)
+        self._finish_cycle(batch, cycle_t0, timed, t_neg, t_disp)
+        return True
 
     def _negotiate(self, batch: list[TensorEntry]) -> list[TensorEntry]:
         """One negotiation round: post the pending set, receive the
@@ -757,6 +1009,16 @@ class BackgroundRuntime:
             if self.recorder is not None:
                 self.recorder.note("negotiation_round", state="end",
                                    round=rnd, ok=ok)
+        return self._process_response(resp)
+
+    def _process_response(self, resp: dict) -> list[TensorEntry]:
+        """Apply one negotiation response to the pending table: fail
+        errored entries, record straggler verdicts, pop the ready set in
+        coordinator order, fabricate joined zero-contributions, and note
+        join completion. Shared by `_negotiate` and the megaplan
+        lease-drop fallback — a dropped lease still consumed its round,
+        so its response must flow through the identical path."""
+        ready, errors = resp["ready"], resp["errors"]
         for n, msg in errors.items():
             e = self._pending.pop(n, None)
             if e is not None:
@@ -983,6 +1245,18 @@ class BackgroundRuntime:
                         ps, e0.reduce_op, e0.prescale_factor,
                         e0.postscale_factor, tuple(names), sizes, shapes,
                         dtype, on_dev)
+                cap = self._mp_capture
+                if cap is not None:
+                    if type(plan) is C.FusedChunkPlan:
+                        # record this chunk's schedule step; the plan
+                        # object is an owned reference, so later LRU
+                        # eviction cannot tear a live megaplan
+                        cap.append((tuple(names), plan, on_dev,
+                                    total_bytes, dtype))
+                    else:
+                        # legacy eager chain / zero-element chunk: the
+                        # step is not whole-step replayable
+                        self._mp_capture = None
                 if self.tracer is not None:
                     disp0 = time.time()
                     for e in chunk:
@@ -1023,6 +1297,7 @@ class BackgroundRuntime:
                 for e, p in zip(chunk, parts):
                     self._finish(e, p)
             except Exception as exc:  # fail the whole chunk
+                self._mp_capture = None  # a failed chunk is uncapturable
                 self._m_op_errors.inc(len(chunk))
                 for e in chunk:
                     self._finish(e, None,
@@ -1066,6 +1341,10 @@ class BackgroundRuntime:
         error is never double-applied (tests/test_quantized.py chaos
         coverage). The store itself resets on elastic-generation change
         (compression.ResidualStore)."""
+        # the residual read-then-commit lifecycle has per-dispatch state a
+        # captured schedule could not replay safely: quant steps opt out
+        # of whole-step capture
+        self._mp_capture = None
         store = self._quant_residuals
         for chunk in self._chunk_group(group):
             names = [e.name for e in chunk]

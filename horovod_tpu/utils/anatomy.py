@@ -65,8 +65,10 @@ LANE_LIMIT = 200
 #: windows of fused/quantized/single-tensor plans; ``negotiate`` is the
 #: controller round (carrying any coordinator-attributed stall slice);
 #: ``host_gap`` is wall time outside both; ``compile`` is XLA compile
-#: seconds handed over by the memledger.
-KINDS = ("chunk", "negotiate", "host_gap", "compile")
+#: seconds handed over by the memledger; ``megaplan`` is a whole-step
+#: replay's single chained dispatch (ops/megaplan.py) — the step had no
+#: per-chunk dispatch windows to decompose into.
+KINDS = ("chunk", "negotiate", "host_gap", "compile", "megaplan")
 
 
 def _entity_name(names: Sequence[str], prefix: str = "") -> str:
@@ -148,6 +150,23 @@ class AnatomyProfiler:
         self._cycle_chunks.append(
             (ent, token, t0_pc if t0_pc is not None else time.perf_counter()))
 
+    def note_megaplan(self, names: Sequence[str], nbytes: int,
+                      tensors: int, dispatch_s: float, token=None,
+                      t0_pc: Optional[float] = None) -> None:
+        """One whole-step megaplan replay (ops/megaplan.py): the entire
+        captured schedule rode a single chained dispatch, so the step
+        contributes one ``megaplan`` entity instead of per-chunk spans —
+        GET /timeline renders it as its own lane."""
+        dispatch_s = max(float(dispatch_s), 0.0)
+        ent = {"kind": "megaplan",
+               "name": _entity_name(names, prefix="megaplan:"),
+               "bytes": int(nbytes), "tensors": int(tensors),
+               "span_s": dispatch_s, "exposed_s": dispatch_s,
+               "device_done": token is None,
+               "ts0": time.time() - dispatch_s}
+        self._cycle_chunks.append(
+            (ent, token, t0_pc if t0_pc is not None else time.perf_counter()))
+
     def note_compile(self, seconds: float) -> None:
         """Attribute one XLA compile's wall time to the next recorded
         step (called from the memledger's compile instrumentation)."""
@@ -197,7 +216,8 @@ class AnatomyProfiler:
                              "span_s": compile_s, "exposed_s": 0.0,
                              "ts0": now - compile_s})
 
-        chunk_span = sum(e["span_s"] for e in entities if e["kind"] == "chunk")
+        chunk_span = sum(e["span_s"] for e in entities
+                         if e["kind"] in ("chunk", "megaplan"))
         # every background-queue collective is overlappable: consumers
         # block in synchronize(), not at dispatch, so its host-blocking
         # window is pure headroom for an overlap scheduler
@@ -337,7 +357,7 @@ class AnatomyProfiler:
         out: List[dict] = []
         for rec in recs:
             for ent in rec["entities"]:
-                if ent["kind"] != "chunk":
+                if ent["kind"] not in ("chunk", "megaplan"):
                     continue
                 out.append({"name": ent["name"], "ts0": ent["ts0"],
                             "dur_s": ent["span_s"], "kind": ent["kind"]})

@@ -60,6 +60,7 @@ SITES = (
     "autotune.propose",
     "plan.dispatch",
     "ckpt.write", "ckpt.flush",
+    "megaplan.capture", "megaplan.replay",
 )
 
 MODES = ("drop", "delay", "error", "fail", "torn")

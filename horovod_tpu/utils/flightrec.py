@@ -54,6 +54,7 @@ CATEGORIES = (
     ("leader_round", "node-leader negotiation round merged or fell back"),
     ("autotune_step", "autotuner proposed/applied/reverted a config"),
     ("checkpoint", "async checkpoint snapshot/flush/restore lifecycle"),
+    ("megaplan", "whole-step schedule captured/replayed/invalidated"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)
