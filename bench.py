@@ -583,6 +583,19 @@ def main():
         extras["ckpt_write_s"] = None
         extras["ckpt_restore_s"] = None
         extras["ckpt_shard_bytes"] = None
+    # Fleet-health verdict when HOROVOD_HEALTH is on
+    # (docs/observability.md "Fleet health & history"). Same
+    # None-when-off convention: the driver's trend tooling can tell
+    # "health off" from "healthy, zero anomalies" ("healthy"/0/None).
+    hrep = hvd.health_report()
+    if hrep.get("enabled"):
+        extras["health_verdict"] = hrep.get("verdict")
+        extras["health_anomalies_total"] = hrep.get("anomalies_total")
+        extras["health_suspect_rank"] = hrep.get("suspect_rank")
+    else:
+        extras["health_verdict"] = None
+        extras["health_anomalies_total"] = None
+        extras["health_suspect_rank"] = None
     # Attribution stamp: which code and which knob snapshot produced
     # these numbers — benchguard baselines are meaningless without it.
     extras["git_sha"] = _git_sha()

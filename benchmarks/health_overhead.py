@@ -1,0 +1,77 @@
+"""Fleet health engine overhead on the background cycle loop (CPU).
+
+Enforces the zero-cost contract of horovod_tpu/utils/health.py: with
+``HOROVOD_HEALTH`` unset no engine exists and the only hook (the
+MetricsDumper flush) pays one ``is None`` check, so the health-off
+build must sit inside measurement noise of the pre-health baseline
+(the ISSUE 19 A/A acceptance gate: within 2%, checked against
+benchmarks/health_budgets.json via tools/benchguard) — and the
+health-on build (a windowed ledger read, ring appends, and one robust-z
+pass per dump interval, all off the step path) must stay bounded, not
+free.
+
+Reuses the cycle_overhead.py harness (same synthetic 20-tensor fused
+workload, same inline ``run_cycle()`` timing) through the shared A/A
+harness in _common.py; the only variable here is the process engine's
+presence.
+
+Run directly for a JSON line:
+
+    JAX_PLATFORMS=cpu python benchmarks/health_overhead.py
+
+or import ``measure_health()`` (the tier-1 smoke test in
+tests/test_health.py does, with small cycle counts and a loose bound,
+so a hot-path regression surfaces in CI rather than on a chip window).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # loaded via spec_from_file_location in tests
+    sys.path.insert(1, _HERE)
+
+import _common  # noqa: E402  (benchmarks/ sibling)
+import cycle_overhead  # noqa: E402  (benchmarks/ sibling)
+
+NOISE_MARGIN = _common.AA_NOISE_MARGIN
+
+
+def measure_health(health_on: bool, cycles: int = 50,
+                   warmup: int = 5) -> dict:
+    """cycle_overhead.measure (plans enabled) with the process health
+    engine toggled for the runtime under test. Restores the engine-less
+    state on exit so callers / later tests see the default."""
+    from horovod_tpu.common import env as env_schema
+    from horovod_tpu.utils import health as health_mod
+
+    try:
+        if health_on:
+            os.environ[env_schema.HOROVOD_HEALTH] = "1"
+            health_mod.init_engine(rank=0)
+        else:
+            os.environ.pop(env_schema.HOROVOD_HEALTH, None)
+            health_mod.reset_engine()
+        out = cycle_overhead.measure(plans_enabled=True, cycles=cycles,
+                                     warmup=warmup)
+    finally:
+        os.environ.pop(env_schema.HOROVOD_HEALTH, None)
+        health_mod.reset_engine()
+    out["health_on"] = health_on
+    return out
+
+
+def main() -> int:
+    # Two health-off configs establish the A/A noise floor on this
+    # host; health-off must sit within that floor (+ margin) of the
+    # baseline, because with the engine None the two runs execute
+    # identical code. Interleaving/pairing rationale lives in
+    # _common.aa_overhead_main.
+    return _common.aa_overhead_main(measure_health, "health")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,21 @@ def checkpoint_report() -> dict:
     return _async_ckpt.report()
 
 
+def health_report() -> dict:
+    """This rank's fleet-health status (utils/health.py): the local
+    verdict (healthy/degraded/critical), active anomalies, total
+    anomalies latched, learned per-series baselines, the newest value
+    of each history series, and the suspect rank when anomalies are
+    active and straggler attribution is fresh. ``{"enabled": False}``
+    unless HOROVOD_HEALTH was set at init. The merged cross-rank views
+    are ``GET /history`` and ``GET /health`` on the launcher's
+    rendezvous server (docs/observability.md, "Fleet health &
+    history")."""
+    from .utils import health as _health
+
+    return _health.report()
+
+
 def diagnose() -> dict:
     """The local diagnostic bundle (utils/diag.py): all-thread stacks,
     lockcheck state, a metrics snapshot, open tracing spans, the flight

@@ -308,6 +308,12 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
             rank=_ctx.global_set.cross_rank,
             world=_ctx.global_set.cross_size)
 
+        # fleet health engine, same placement rationale as the ledgers:
+        # the MetricsDumper flush hook checks the engine handle per pass
+        from ..utils import health as health_mod
+
+        health_mod.init_engine(rank=_ctx.global_set.cross_rank)
+
         if _ctx.config.trace_enabled:
             # before the runtime/controller construct: both resolve the
             # tracer once at build time (zero-cost None when off)
@@ -342,6 +348,11 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
             perfledger_mod.init_ledger(
                 rank=_ctx.global_set.cross_rank,
                 stall_inspector=_ctx.stall_inspector)
+            # same handover for the health engine: anomaly escalations
+            # carry straggler attribution once the inspector exists
+            health_mod.init_engine(
+                rank=_ctx.global_set.cross_rank,
+                stall_inspector=_ctx.stall_inspector)
             _ctx.runtime = BackgroundRuntime(
                 _ctx.global_set,
                 config=_ctx.config,
@@ -363,6 +374,11 @@ def init(ranks: Optional[Sequence[int]] = None, *, start_runtime: bool = True):
                 _ctx.runtime.autotuner = _ctx.autotuner
                 _ctx.runtime.autotune_steps_per_sample = (
                     _ctx.config.autotune_steps_per_sample)
+                # hand the tuner to the health engine so a latched
+                # goodput drift feeds the workload-shift re-tune path
+                health_mod.init_engine(
+                    rank=_ctx.global_set.cross_rank,
+                    autotuner=_ctx.autotuner)
         _start_metrics_dumper()
         _ctx.initialized = True
         from ..utils import flightrec as flightrec_mod
@@ -461,6 +477,12 @@ def shutdown(drain: bool = True):
             # reflects everything the drained runtime counted
             _ctx.metrics_dumper.stop()
             _ctx.metrics_dumper = None
+        from ..utils import health as health_mod
+
+        # after the dumper's final flush so the HOROVOD_HEALTH_FILE dump
+        # carries the last sampled window (engine survives shutdown like
+        # the ledgers: one continuous history per process)
+        health_mod.dump_on_exit()
         from ..utils import diag as diag_mod
 
         # the flight recorder survives shutdown (one continuous ring per

@@ -171,6 +171,15 @@ HOROVOD_MEGAPLAN_STABLE_ROUNDS = "HOROVOD_MEGAPLAN_STABLE_ROUNDS"
 HOROVOD_ASYNC_CKPT = "HOROVOD_ASYNC_CKPT"
 HOROVOD_ASYNC_CKPT_DIR = "HOROVOD_ASYNC_CKPT_DIR"
 HOROVOD_PREEMPT_GRACE_S = "HOROVOD_PREEMPT_GRACE_S"
+# fleet health engine (utils/health.py; docs/observability.md "Fleet
+# health & history"): master switch, per-series history ring capacity,
+# samples collected before the drift detector freezes its median/MAD
+# baseline, and an optional path the full history rings are dumped to at
+# shutdown (renderable by tools/benchtrend --from-history)
+HOROVOD_HEALTH = "HOROVOD_HEALTH"
+HOROVOD_HEALTH_BUFFER = "HOROVOD_HEALTH_BUFFER"
+HOROVOD_HEALTH_WARMUP = "HOROVOD_HEALTH_WARMUP"
+HOROVOD_HEALTH_FILE = "HOROVOD_HEALTH_FILE"
 
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -321,6 +330,13 @@ class RuntimeConfig:
     async_ckpt: bool = False
     async_ckpt_dir: str = ""
     preempt_grace_s: float = 15.0
+    # fleet health engine (utils/health.py) — off by default (zero-cost
+    # contract: no hvd_health_* series); health_file="" skips the
+    # on-exit history dump
+    health_enabled: bool = False
+    health_buffer: int = 512
+    health_warmup: int = 20
+    health_file: str = ""
     # control-plane scale-out (ops/controller.py + runner/http_server.py)
     # — off by default: the negotiation wire is byte-identical to the
     # flat/JSON v1 protocol and no hvd_hier_*/wire-v2 series exist
@@ -405,6 +421,10 @@ class RuntimeConfig:
         c.async_ckpt_dir = get_str(HOROVOD_ASYNC_CKPT_DIR)
         c.preempt_grace_s = get_float(HOROVOD_PREEMPT_GRACE_S,
                                       c.preempt_grace_s)
+        c.health_enabled = get_bool(HOROVOD_HEALTH)
+        c.health_buffer = get_int(HOROVOD_HEALTH_BUFFER, c.health_buffer)
+        c.health_warmup = get_int(HOROVOD_HEALTH_WARMUP, c.health_warmup)
+        c.health_file = get_str(HOROVOD_HEALTH_FILE)
         c.hier_negotiation = get_bool(HOROVOD_HIER_NEGOTIATION)
         c.hier_group_size = get_int(HOROVOD_HIER_GROUP_SIZE,
                                     c.hier_group_size)

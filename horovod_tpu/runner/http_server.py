@@ -65,6 +65,42 @@ def _stale_ranks(entries) -> set:
     return out
 
 
+def _merged_snapshots(server, kv_scope: str, local=None) -> dict:
+    """The shared per-rank snapshot merge every telemetry endpoint
+    (``/perf``//``/memory``//``/anatomy``//``/checkpoint``//``/history``//
+    ``/health``) serves: decode every ``{scope}/rank{k}`` push (skipping
+    half-written payloads — the next poll catches up), annotate each
+    rank ``stale`` when its push stamp lags the newest push
+    (annotate-don't-drop, judged by :func:`_stale_ranks`), and merge the
+    launcher-local module's own snapshot when it has one and no push
+    shadows it. ``local`` is an optional ``(rank, snapshot_fn)`` pair.
+    Returns ``{rank: snapshot}`` keyed by rank string."""
+    import json
+
+    scope_prefix = kv_scope + "/"
+    pushed = server.scan_prefix(scope_prefix)
+    entries = []
+    for k, v in sorted(pushed.items()):
+        suffix = k[len(scope_prefix):]  # "rank1"
+        rank = suffix[4:] if suffix.startswith("rank") else suffix
+        try:
+            entries.append((rank, json.loads(v)))
+        except (ValueError, UnicodeDecodeError):
+            continue  # half-written push: skip, next poll catches up
+    stale = _stale_ranks(entries)
+    ranks = {}
+    for rank, snap in entries:
+        snap["stale"] = rank in stale
+        ranks[rank] = snap
+    if local is not None:
+        local_rank, snapshot_fn = local
+        if str(local_rank) not in ranks:
+            snap = snapshot_fn()
+            snap["stale"] = False
+            ranks[str(local_rank)] = snap
+    return ranks
+
+
 class KVAuthError(RuntimeError):
     """A KV exchange failed authentication: either the store refused our
     digest (key mismatch / tampered request) or a GET response's digest
@@ -108,6 +144,16 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def _send_json(self, obj):
+        import json
+
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_PUT(self):
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
@@ -139,6 +185,13 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._do_shards()
         if key == "checkpoint":
             return self._do_checkpoint()
+        # health endpoints take a query string; KV keys are always
+        # scope/key (contain a slash), so bare names cannot collide
+        base, _, query = key.partition("?")
+        if base == "history":
+            return self._do_history(query)
+        if base == "health":
+            return self._do_health()
         if not self._authorized():
             return self._reject()
         store = self.server.store  # type: ignore[attr-defined]
@@ -320,36 +373,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         ``stale`` flag when that rank's push stamp lags the newest push
         (same annotate-don't-drop policy as ``/metrics``). Auth-exempt
         read-only telemetry, same rationale as ``/metrics``."""
-        import json
-
         from ..utils import perfledger as perfledger_mod
 
-        scope_prefix = perfledger_mod.KV_SCOPE + "/"
-        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
-        entries = []
-        for k, v in sorted(pushed.items()):
-            suffix = k[len(scope_prefix):]  # "rank1"
-            rank = suffix[4:] if suffix.startswith("rank") else suffix
-            try:
-                entries.append((rank, json.loads(v)))
-            except (ValueError, UnicodeDecodeError):
-                continue  # half-written push: skip, next poll catches up
-        stale = _stale_ranks(entries)
-        ranks = {}
-        for rank, snap in entries:
-            snap["stale"] = rank in stale
-            ranks[rank] = snap
         local = perfledger_mod.get_ledger()
-        if local is not None and str(local.rank) not in ranks:
-            snap = local.snapshot()
-            snap["stale"] = False
-            ranks[str(local.rank)] = snap
-        body = json.dumps({"ranks": ranks}).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        ranks = _merged_snapshots(
+            self.server, perfledger_mod.KV_SCOPE,
+            (local.rank, local.snapshot) if local is not None else None)
+        self._send_json({"ranks": ranks})
 
     def _do_anatomy(self):
         """``GET /anatomy``: merge every step-anatomy snapshot ranks
@@ -360,36 +390,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         lags the newest push (same annotate-don't-drop policy as
         ``/perf``). Auth-exempt read-only telemetry, same rationale as
         ``/metrics``."""
-        import json
-
         from ..utils import anatomy as anatomy_mod
 
-        scope_prefix = anatomy_mod.KV_SCOPE + "/"
-        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
-        entries = []
-        for k, v in sorted(pushed.items()):
-            suffix = k[len(scope_prefix):]  # "rank1"
-            rank = suffix[4:] if suffix.startswith("rank") else suffix
-            try:
-                entries.append((rank, json.loads(v)))
-            except (ValueError, UnicodeDecodeError):
-                continue  # half-written push: skip, next poll catches up
-        stale = _stale_ranks(entries)
-        ranks = {}
-        for rank, snap in entries:
-            snap["stale"] = rank in stale
-            ranks[rank] = snap
         local = anatomy_mod.get_profiler()
-        if local is not None and str(local.rank) not in ranks:
-            snap = local.snapshot()
-            snap["stale"] = False
-            ranks[str(local.rank)] = snap
-        body = json.dumps({"ranks": ranks}).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        ranks = _merged_snapshots(
+            self.server, anatomy_mod.KV_SCOPE,
+            (local.rank, local.snapshot) if local is not None else None)
+        self._send_json({"ranks": ranks})
 
     def _do_memory(self):
         """``GET /memory``: merge every device-memory-ledger snapshot
@@ -399,36 +406,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         ``stale`` flag when that rank's push stamp lags the newest push
         (same annotate-don't-drop policy as ``/metrics``). Auth-exempt
         read-only telemetry, same rationale as ``/metrics``."""
-        import json
-
         from ..utils import memledger as memledger_mod
 
-        scope_prefix = memledger_mod.KV_SCOPE + "/"
-        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
-        entries = []
-        for k, v in sorted(pushed.items()):
-            suffix = k[len(scope_prefix):]  # "rank1"
-            rank = suffix[4:] if suffix.startswith("rank") else suffix
-            try:
-                entries.append((rank, json.loads(v)))
-            except (ValueError, UnicodeDecodeError):
-                continue  # half-written push: skip, next poll catches up
-        stale = _stale_ranks(entries)
-        ranks = {}
-        for rank, snap in entries:
-            snap["stale"] = rank in stale
-            ranks[rank] = snap
         local = memledger_mod.get_ledger()
-        if local is not None and str(local.rank) not in ranks:
-            snap = local.snapshot()
-            snap["stale"] = False
-            ranks[str(local.rank)] = snap
-        body = json.dumps({"ranks": ranks}).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        ranks = _merged_snapshots(
+            self.server, memledger_mod.KV_SCOPE,
+            (local.rank, local.snapshot) if local is not None else None)
+        self._send_json({"ranks": ranks})
 
     def _do_checkpoint(self):
         """``GET /checkpoint``: merge every async-checkpoint status
@@ -443,31 +427,14 @@ class _KVHandler(BaseHTTPRequestHandler):
         rationale as ``/metrics`` — this is the endpoint an operator
         polls to decide whether a preempted job left a restorable
         snapshot behind."""
-        import json
-
         from ..common import env as env_schema
         from ..utils import async_ckpt as async_ckpt_mod
 
-        scope_prefix = async_ckpt_mod.KV_SCOPE + "/"
-        pushed = self.server.scan_prefix(scope_prefix)  # type: ignore[attr-defined]
-        entries = []
-        for k, v in sorted(pushed.items()):
-            suffix = k[len(scope_prefix):]  # "rank1"
-            rank = suffix[4:] if suffix.startswith("rank") else suffix
-            try:
-                entries.append((rank, json.loads(v)))
-            except (ValueError, UnicodeDecodeError):
-                continue  # half-written push: skip, next poll catches up
-        stale = _stale_ranks(entries)
-        ranks = {}
-        for rank, snap in entries:
-            snap["stale"] = rank in stale
-            ranks[rank] = snap
         local = async_ckpt_mod.get_checkpointer()
-        if local is not None and str(local.rank) not in ranks:
-            snap = local.snapshot_status()
-            snap["stale"] = False
-            ranks[str(local.rank)] = snap
+        ranks = _merged_snapshots(
+            self.server, async_ckpt_mod.KV_SCOPE,
+            (local.rank, local.snapshot_status)
+            if local is not None else None)
         manifest = None
         ckpt_dir = (env_schema.get_str(env_schema.HOROVOD_ASYNC_CKPT_DIR)
                     or (local.directory if local is not None else ""))
@@ -475,12 +442,70 @@ class _KVHandler(BaseHTTPRequestHandler):
             m = async_ckpt_mod.read_manifest(ckpt_dir)
             if m is not None:
                 manifest = {k: v for k, v in m.items() if k != "ranks"}
-        body = json.dumps({"ranks": ranks, "manifest": manifest}).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._send_json({"ranks": ranks, "manifest": manifest})
+
+    def _health_ranks(self) -> dict:
+        from ..utils import health as health_mod
+
+        local = health_mod.get_engine()
+        return _merged_snapshots(
+            self.server, health_mod.KV_SCOPE,
+            (local.rank, local.snapshot) if local is not None else None)
+
+    def _do_history(self, query: str = ""):
+        """``GET /history``: merge every fleet-health history snapshot
+        ranks pushed under the ``health/`` KV scope (utils/health.py)
+        into one JSON view — per rank: the per-series sample rings
+        (raw + downsampled tiers), active anomalies, learned baselines,
+        and a ``stale`` flag when that rank's push stamp lags the newest
+        push (same annotate-don't-drop policy as ``/perf``). Windowed
+        query: ``?series=a,b&since=<unix ts>`` filters series by name
+        and drops points older than the stamp. Auth-exempt read-only
+        telemetry, same rationale as ``/metrics``; the dump body is
+        renderable by ``tools/benchtrend --from-history``."""
+        from urllib.parse import parse_qs
+
+        params = parse_qs(query)
+        wanted = {s for v in params.get("series", [])
+                  for s in v.split(",") if s}
+        try:
+            since = float(params.get("since", ["0"])[-1])
+        except ValueError:
+            since = 0.0
+        ranks = self._health_ranks()
+        if wanted or since > 0:
+            for snap in ranks.values():
+                series = snap.get("series")
+                if not isinstance(series, dict):
+                    continue
+                out = {}
+                for name, body in series.items():
+                    if wanted and name not in wanted:
+                        continue
+                    if since > 0 and isinstance(body, dict):
+                        body = dict(body)
+                        for tier in ("samples", "downsampled"):
+                            pts = body.get(tier)
+                            if isinstance(pts, list):
+                                body[tier] = [
+                                    p for p in pts
+                                    if isinstance(p, (list, tuple))
+                                    and len(p) == 2 and p[0] >= since]
+                    out[name] = body
+                snap["series"] = out
+        self._send_json({"ranks": ranks})
+
+    def _do_health(self):
+        """``GET /health``: the single fleet verdict
+        (healthy/degraded/critical) distilled from every rank's pushed
+        health snapshot — ranked suspects by cross-rank outlier score,
+        active anomalies with owning rank, per-rank verdict/staleness,
+        and learned baselines (utils/health.py fleet_view). Auth-exempt
+        read-only telemetry, same rationale as ``/metrics`` — this is
+        the one-probe answer to "did the job get worse, and where"."""
+        from ..utils import health as health_mod
+
+        self._send_json(health_mod.fleet_view(self._health_ranks()))
 
     def _do_shards(self):
         """``GET /shards``: the binary shard listeners' routing table —

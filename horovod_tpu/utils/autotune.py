@@ -751,15 +751,36 @@ class Autotuner:
         if self._rank != 0:
             return
         LOG.info("autotune: workload shifted, restarting search")
+        self._restart_search()
+
+    def _restart_search(self):
+        """Re-arm the search from scratch (rank 0 only): workload-shift
+        and health-drift restarts share this path. Old scores measured a
+        different workload, so they are voided — including _best_score /
+        _best_params, so the revert guardrail cannot loop the search
+        back onto a config tuned for the pre-shift regime."""
         self._samples = 0
         self.done = False
         self._final_submitted = False
         self._strikes = 0
-        # old scores measured a different workload: void them
         self._best_score = None
         self._best_params = None
         self._opt = self._new_opt()
         self._m_done.set(0)
+
+    def note_health_drift(self, series: str):
+        """A latched health drift verdict (utils/health.py) on a goodput
+        series the tuner optimizes — treat it as a confirmed workload
+        shift and restart the search. Debounce lives on the health side:
+        anomalies latch once per episode, so one drifted regime provokes
+        at most one re-tune until the series clears and re-arms."""
+        self._m_shifts.inc()
+        flightrec_mod.note("autotune_step", action="health_drift",
+                           series=series)
+        if self._rank != 0:
+            return
+        LOG.info("autotune: health drift on %r, restarting search", series)
+        self._restart_search()
 
     # -- parameter broadcast (SynchronizeParameters, controller.cc:39-53) ---
     def _submit(self, params: dict, final: bool):

@@ -61,6 +61,7 @@ SITES = (
     "plan.dispatch",
     "ckpt.write", "ckpt.flush",
     "megaplan.capture", "megaplan.replay",
+    "health.sample",
 )
 
 MODES = ("drop", "delay", "error", "fail", "torn")

@@ -55,6 +55,7 @@ CATEGORIES = (
     ("autotune_step", "autotuner proposed/applied/reverted a config"),
     ("checkpoint", "async checkpoint snapshot/flush/restore lifecycle"),
     ("megaplan", "whole-step schedule captured/replayed/invalidated"),
+    ("health", "fleet-health anomaly latched or cleared on a drifted series"),
 )
 
 CATEGORY_NAMES = frozenset(name for name, _ in CATEGORIES)

@@ -268,6 +268,18 @@ class MetricsRegistry:
                     out[n] += m._value
         return out
 
+    def gauge_value(self, name: str) -> Optional[float]:
+        """Non-creating read of a gauge family (first label set wins;
+        the families this serves — feature toggles and peaks — are
+        single-set). None when absent, so a reader (the health engine)
+        can sample a feature-gated gauge without registering it and
+        breaking that feature's zero-series-when-off contract."""
+        with self._lock:
+            for (n, _), m in self._metrics.items():
+                if n == name and isinstance(m, Gauge):
+                    return float(m._value)
+        return None
+
     def histogram_quantile(self, name: str, q: float) -> Optional[float]:
         """Bucket-interpolated quantile over a histogram family, merged
         across label sets (the PromQL ``histogram_quantile`` estimate:
@@ -534,6 +546,31 @@ class MetricsDumper:
                     json.dumps(csnap).encode())
         except Exception as e:
             LOG.debug("checkpoint KV push failed: %s", e)
+        # fleet-health sampling + detection ride the same cadence: the
+        # flush interval IS the history-sampling cadence, and the pushed
+        # snapshots feed the launcher's GET /history and GET /health
+        # merges. Sampling sits outside the kv_client gate so file-only
+        # (and test) dumpers still detect; the fault point precedes the
+        # sample so a "drop" skips the whole pass cleanly (no torn ring).
+        try:
+            from . import faults as faults_mod
+            from . import health as health_mod
+
+            heng = health_mod.get_engine()
+            if heng is not None:
+                faults_mod.fault_point("health.sample")
+                heng.sample_and_detect()
+                if self.kv_client is not None:
+                    hsnap = heng.snapshot()
+                    hsnap["push_seq"] = self._push_seq
+                    hsnap["push_ts"] = time.time()
+                    hsnap["push_interval_s"] = self.interval_s
+                    payload = faults_mod.corrupt(
+                        "health.sample", json.dumps(hsnap).encode())
+                    self.kv_client.put(
+                        health_mod.KV_SCOPE, f"rank{self.rank}", payload)
+        except Exception as e:
+            LOG.debug("health sample/push failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

@@ -102,6 +102,26 @@ class StallInspector:
                     self._suspect())
         self._m_warnings.inc()
 
+    def straggler_rank(self) -> Optional[int]:
+        """The last coordinator-attributed straggler rank, or None when
+        attribution is absent or stale (same freshness window the text
+        suspect line uses) — the health engine's suspect_rank source."""
+        if self._last_straggler is None:
+            return None
+        rank, _, _, t = self._last_straggler
+        if time.monotonic() - t > self.STRAGGLER_FRESH_S:
+            return None
+        return rank
+
+    def note_health_anomaly(self, series: str, detail: str):
+        """Escalate a latched fleet-health anomaly (utils/health.py)
+        through the same warning path an SLO breach takes — naming the
+        drifted series, observed-vs-baseline, and (when the coordinator
+        attributed a recent straggler) the suspect rank."""
+        LOG.warning("Health anomaly on %r: %s.%s", series, detail,
+                    self._suspect())
+        self._m_warnings.inc()
+
     def check(self):
         """Called once per background cycle (reference: invoked from
         ComputeResponseList, controller.cc:294)."""

@@ -53,6 +53,51 @@ def load_rounds(pattern: str) -> List[dict]:
     return out
 
 
+def load_history_dump(path: str) -> List[dict]:
+    """A live job's health history as a trajectory: accepts either a
+    ``GET /history`` dump (``{"ranks": {rank: {"series": ...}}}``) or a
+    single rank's ``HOROVOD_HEALTH_FILE`` on-exit dump
+    (``{"rank": k, "series": ...}``) and synthesizes one pseudo-round
+    per sample point so history renders through the same table/arrow
+    pipeline as banked ``BENCH_r*.json`` rounds. Multi-rank dumps prefix
+    metrics ``rank{k}/`` — prefix, not suffix, so benchguard's
+    ``resolve_direction`` suffix inference (``_ms`` → lower-is-better)
+    still judges the underlying series name. Returns ``[]`` on an
+    unreadable or shapeless file (the CLI maps that to exit 2)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    if isinstance(doc.get("ranks"), dict):
+        per_rank = [(str(rank), snap)
+                    for rank, snap in sorted(doc["ranks"].items())
+                    if isinstance(snap, dict)]
+    else:
+        per_rank = [(str(doc.get("rank", 0)), doc)]
+    multi = len(per_rank) > 1
+    points = []  # (ts, metric, value)
+    for rank, snap in per_rank:
+        series = snap.get("series")
+        if not isinstance(series, dict):
+            continue
+        for name, body in sorted(series.items()):
+            samples = body.get("samples") if isinstance(body, dict) else None
+            if not isinstance(samples, list):
+                continue
+            metric = f"rank{rank}/{name}" if multi else name
+            for p in samples:
+                if isinstance(p, (list, tuple)) and len(p) == 2 \
+                        and isinstance(p[1], (int, float)):
+                    points.append((float(p[0]), metric, float(p[1])))
+    points.sort()
+    return [{"n": i, "path": path,
+             "parsed": {"metric": metric, "value": value, "unit": None}}
+            for i, (_, metric, value) in enumerate(points)]
+
+
 def _pct(cur: float, prev: float) -> Optional[float]:
     if prev == 0:
         return None

@@ -10,7 +10,7 @@ import argparse
 import json
 import sys
 
-from . import build_rows, load_rounds, render_markdown
+from . import build_rows, load_history_dump, load_rounds, render_markdown
 
 
 def main(argv=None) -> int:
@@ -20,11 +20,22 @@ def main(argv=None) -> int:
                     "markdown table with per-metric direction arrows")
     ap.add_argument("pattern", nargs="?", default="BENCH_r*.json",
                     help="glob of banked rounds (default: BENCH_r*.json)")
+    ap.add_argument("--from-history", metavar="DUMP",
+                    help="render a live-job health history dump (GET "
+                         "/history or HOROVOD_HEALTH_FILE JSON) instead "
+                         "of banked rounds")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the rows as JSON instead of markdown")
     args = ap.parse_args(argv)
 
-    rounds = load_rounds(args.pattern)
+    if args.from_history:
+        rounds = load_history_dump(args.from_history)
+        if not rounds:
+            print(f"benchtrend: no history points in "
+                  f"{args.from_history!r}", file=sys.stderr)
+            return 2
+    else:
+        rounds = load_rounds(args.pattern)
     if not rounds:
         print(f"benchtrend: nothing matched {args.pattern!r}",
               file=sys.stderr)
