@@ -757,10 +757,43 @@ def _emit_result(stdout_text: str, stderr_text: str = "") -> bool:
         json_line = json.dumps(doc)
     except Exception as e:
         sys.stderr.write(f"benchguard verdict skipped: {e}\n")
+    # Static-analysis verdict rides along the same way: advisory in the
+    # artifact, enforced by the tier-1 suite and the entry lint gate.
+    try:
+        doc = json.loads(json_line)
+        doc.setdefault("extras", {})["hvdlint"] = _lint_snapshot()
+        json_line = json.dumps(doc)
+    except Exception as e:
+        sys.stderr.write(f"hvdlint snapshot skipped: {e}\n")
     _write_result_file(json_line)
     sys.stdout.write(json_line + "\n")
     sys.stdout.flush()
     return True
+
+
+def _lint_snapshot(timeout_s: float = 180.0) -> dict:
+    """Pre-test static-analysis verdict for the artifact: runs
+    ``python -m tools.hvdlint --json`` (stdlib-ast, no JAX import) and
+    returns a compact summary. Advisory, like the benchguard verdict —
+    the bench must emit its measurement even on a dirty tree (the tier-1
+    suite and ``__graft_entry__``'s lint gate are the enforcing paths) —
+    but a banked number should record whether the code that produced it
+    satisfied the project invariants."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "--json"],
+            cwd=here, capture_output=True, text=True, timeout=timeout_s)
+        finds = json.loads(p.stdout or "[]")
+        out = {"clean": p.returncode == 0, "findings": len(finds)}
+        if finds:
+            out["fingerprints"] = [
+                f.get("fingerprint") for f in finds[:20]]
+        return out
+    except Exception as e:  # analyzer unavailable ≠ dirty: record which
+        return {"clean": None, "error": repr(e)[:200]}
 
 
 def _diag_artifacts(diag_dir: str, max_age_s: float = 7200.0) -> list:

@@ -181,6 +181,29 @@ HOROVOD_HEALTH_BUFFER = "HOROVOD_HEALTH_BUFFER"
 HOROVOD_HEALTH_WARMUP = "HOROVOD_HEALTH_WARMUP"
 HOROVOD_HEALTH_FILE = "HOROVOD_HEALTH_FILE"
 
+# ---------------------------------------------------------------------------
+# Env-gated subsystems: master switch -> owning module. This mapping IS the
+# machine-readable registry of the zero-cost contract — hvdlint's gate-prover
+# pass (tools/hvdlint/passes/zerocost.py) parses it to decide which modules'
+# hooks must pay at most one is-None check while disabled, and cross-checks
+# it both ways: a module following the gated-trio pattern (enabled() reading
+# a master switch + a module-global None handle) that is missing here fails
+# lint, as does an entry whose module never reads its switch. Keys are the
+# schema constants above; values are repo-relative module paths.
+# ---------------------------------------------------------------------------
+GATED_SUBSYSTEMS = {
+    HOROVOD_TRACE: "horovod_tpu/utils/tracing.py",
+    HOROVOD_FLIGHTREC: "horovod_tpu/utils/flightrec.py",
+    HOROVOD_PERFLEDGER: "horovod_tpu/utils/perfledger.py",
+    HOROVOD_MEMLEDGER: "horovod_tpu/utils/memledger.py",
+    HOROVOD_ANATOMY: "horovod_tpu/utils/anatomy.py",
+    HOROVOD_HEALTH: "horovod_tpu/utils/health.py",
+    HOROVOD_MEGAPLAN: "horovod_tpu/ops/megaplan.py",
+    HOROVOD_AUTOTUNE: "horovod_tpu/utils/autotune.py",
+    HOROVOD_ASYNC_CKPT: "horovod_tpu/utils/async_ckpt.py",
+    HOROVOD_LOCKCHECK: "horovod_tpu/utils/lockcheck.py",
+}
+
 # worker identity (reference: gloo_context.cc:136-192 reads the same set)
 HOROVOD_RANK = "HOROVOD_RANK"
 HOROVOD_SIZE = "HOROVOD_SIZE"

@@ -212,6 +212,34 @@ _PLAN_KEY = "fused_plan"
 _plan_count = 0
 _plan_metric_handles = None
 
+# ---------------------------------------------------------------------------
+# Plan-key ingredients and where they live. Every compiled-plan signature
+# below is a function of these runtime knobs: some appear literally in the
+# key tuples (elastic generation via _plan_epoch(), layout digest, quant
+# signature, hier verdict), others move the chunk boundaries the keys are
+# built over (fusion threshold, chunk granularity, staging slots). hvdlint's
+# invalidation-funnel pass (tools/hvdlint/passes/funnel.py) parses this
+# mapping, cross-checks it against the actual ``key = (_PLAN_KEY, ...)``
+# builders in this module (so a key-layout change that orphans an entry —
+# or a new key element with no entry — fails lint), and then proves that
+# every write to a watched location anywhere in horovod_tpu/ reaches
+# invalidate_fused_plans()/invalidate_megaplan() on all paths.
+#
+# Spec forms: "attr:<name>" watches assignments to ``<anything>.<name>``;
+# "env:<CONST>" watches ``os.environ[env_schema.<CONST>] = ...`` writes.
+# ---------------------------------------------------------------------------
+PLAN_KEY_SOURCES = {
+    "fusion_threshold": ("attr:fusion_threshold",),
+    "chunk_granularity": ("attr:plan_chunk_tensors",),
+    "wire_mode": ("attr:_quant",),
+    "staging_slots": ("attr:staging_ring_slots",),
+    "hier_topology": ("attr:hierarchical_allreduce",
+                      "attr:hierarchical_allgather",
+                      "attr:hier_group_size"),
+    "elastic_generation": ("env:HOROVOD_ELASTIC_GEN",),
+    "layout_digest": ("attr:_layout",),
+}
+
 
 def _plan_metrics():
     """(hits, misses, lru_evictions, invalidations, cache_size_gauge,

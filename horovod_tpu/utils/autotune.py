@@ -640,10 +640,15 @@ class Autotuner:
     @staticmethod
     def _set_hier(hier_ar: bool, hier_ag: bool):
         from horovod_tpu.common import context as ctx_mod
+        from horovod_tpu.ops import megaplan as megaplan_mod
 
         cfg = ctx_mod.context().config
         cfg.hierarchical_allreduce = bool(hier_ar)
         cfg.hierarchical_allgather = bool(hier_ag)
+        # hier topology is a plan-key ingredient: a captured whole-step
+        # schedule spanning the flip must not replay (the coordinator
+        # path funnels in _apply_tuned_params; this direct path must too)
+        megaplan_mod.invalidate_megaplan("hier_topology")
 
     def _current_params(self) -> dict:
         """The runtime's live knob values in this space's vocabulary —
@@ -817,7 +822,13 @@ class Autotuner:
         if setter is not None:
             setter(int(p["fusion"]))
         else:
+            from horovod_tpu.ops import collectives as collectives_mod
+
             self.runtime.fusion_threshold = int(p["fusion"])
+            # the real setter invalidates cached fused plans itself; the
+            # duck-typed direct write must reach the same funnel or a
+            # stale plan keyed on the old threshold keeps executing
+            collectives_mod.invalidate_fused_plans()
         self.runtime.cycle_time_ms = float(p["cycle"])
         if not multi and ("hier_ar" in p or "hier_ag" in p):
             self._set_hier(p.get("hier_ar", False), p.get("hier_ag", False))

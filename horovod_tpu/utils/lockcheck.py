@@ -243,6 +243,13 @@ class Auditor:
         with self._mu:
             return list(self._inversions)
 
+    def edges(self) -> List[Tuple[str, str]]:
+        """Every (held, acquired) name pair observed so far, sorted.
+        tests/test_hvdlint.py asserts these are a subset of the static
+        lock-order graph built by tools/hvdlint's lock-order pass."""
+        with self._mu:
+            return sorted(self._edges)
+
     def long_holds(self) -> List[dict]:
         with self._mu:
             return list(self._long_holds)
@@ -342,6 +349,13 @@ def inversions() -> List[dict]:
     if _GLOBAL is None:
         return []
     return _GLOBAL.inversions()
+
+
+def edges() -> List[Tuple[str, str]]:
+    """(held, acquired) pairs seen by the global auditor ([] when off)."""
+    if _GLOBAL is None:
+        return []
+    return _GLOBAL.edges()
 
 
 def report() -> dict:

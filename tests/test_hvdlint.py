@@ -299,9 +299,10 @@ def test_endpoint_docs_scoped_to_http_server():
 def test_package_clean():
     """The real tree must lint clean — this is the tier-1 gate that keeps
     every invariant (env schema, metric docs, fault sites, zero-cost
-    hooks, guarded-by, wire clocks) enforced going forward."""
+    hooks, guarded-by, wire clocks, and the four whole-program dataflow
+    passes) enforced going forward."""
     rules = make_rules()
-    assert len(rules) >= 7
+    assert len(rules) >= 12
     paths = [os.path.join(_REPO, p)
              for p in ("horovod_tpu", "tests", "benchmarks", "tools")]
     findings = run_lint(paths, root=_REPO, rules=rules)
@@ -327,6 +328,441 @@ def test_cli_json_and_exit_code(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     findings = json.loads(proc.stdout)
     assert [f["rule"] for f in findings] == ["lock-discipline"]
+
+
+# ------------------------------------------- whole-program dataflow passes
+
+from tools.hvdlint import FileContext  # noqa: E402
+from tools.hvdlint.passes import (  # noqa: E402
+    InvalidationFunnelPass, LockOrderPass, ProtocolCoveragePass,
+    ZeroCostGatePass, build_lock_graph)
+
+
+def _finalize_pass(rule, files, project=None):
+    """Feed ``{relpath: source}`` fixtures through one dataflow pass and
+    return its project-level findings (the engine runs these via
+    run_lint; fixture tests call finalize on the instance directly)."""
+    proj = project or _project()
+    for path in sorted(files):
+        list(rule.check_file(FileContext(path, files[path], proj)))
+    return list(rule.finalize(proj))
+
+
+_TRACING_FIXTURE = (
+    "from ..common import env as env_schema\n"
+    "_TRACER = None\n"
+    "def enabled():\n"
+    "    return env_schema.get_bool(env_schema.HOROVOD_TRACE)\n"
+    "def get_tracer():\n"
+    "    return _TRACER\n")
+
+
+def _zerocost_project():
+    p = _project()
+    p.gated_subsystems = {"HOROVOD_TRACE": "horovod_tpu/utils/tracing.py"}
+    p.gated_subsystems_line = 7
+    return p
+
+
+def test_zero_cost_gates_flags_work_before_bail_guard():
+    hook = ("from ..utils import tracing as tracing_mod\n"
+            "def on_event(name):\n"
+            '    label = f"ev:{name}"\n'
+            "    tr = tracing_mod.get_tracer()\n"
+            "    if tr is None:\n"
+            "        return\n"
+            "    tr.emit(label)\n")
+    got = _finalize_pass(
+        ZeroCostGatePass(),
+        {"horovod_tpu/utils/tracing.py": _TRACING_FIXTURE,
+         "horovod_tpu/ops/hooks.py": hook},
+        _zerocost_project())
+    assert [f.rule for f in got] == ["zero-cost-gates"]
+    assert "f-string" in got[0].message
+    assert "HOROVOD_TRACE" in got[0].message
+    assert got[0].path == "horovod_tpu/ops/hooks.py" and got[0].line == 3
+
+
+def test_zero_cost_gates_clean_when_guard_first():
+    hook = ("from ..utils import tracing as tracing_mod\n"
+            "def on_event(name):\n"
+            "    tr = tracing_mod.get_tracer()\n"
+            "    if tr is None:\n"
+            "        return\n"
+            '    tr.emit(f"ev:{name}")\n')
+    assert _finalize_pass(
+        ZeroCostGatePass(),
+        {"horovod_tpu/utils/tracing.py": _TRACING_FIXTURE,
+         "horovod_tpu/ops/hooks.py": hook},
+        _zerocost_project()) == []
+
+
+def test_zero_cost_gates_wrapper_tail_is_not_a_gate():
+    # a value-returning function that merely *ends* with optional gated
+    # work is not a hook body — its unconditional statements run for
+    # their own sake, enabled or not
+    src = ("from ..utils import tracing as tracing_mod\n"
+           "def round_trip(r):\n"
+           '    scope = f"round/{r}"\n'
+           "    raw = do_round(scope)\n"
+           "    tr = tracing_mod.get_tracer()\n"
+           "    if tr is not None:\n"
+           "        tr.emit(scope)\n"
+           "    return raw\n")
+    assert _finalize_pass(
+        ZeroCostGatePass(),
+        {"horovod_tpu/utils/tracing.py": _TRACING_FIXTURE,
+         "horovod_tpu/ops/rounds.py": src},
+        _zerocost_project()) == []
+
+
+def test_zero_cost_gates_coverage_requires_switch_read_and_hooks():
+    # whole-package run (env schema module present): a registered
+    # subsystem whose switch nothing reads and with zero guarded hooks
+    # means the prover covers nothing — both are findings
+    env_src = ('HOROVOD_TRACE = "HOROVOD_TRACE"\n'
+               "def get_bool(name, default=False):\n"
+               "    return False\n"
+               "GATED_SUBSYSTEMS = {\n"
+               '    HOROVOD_TRACE: "horovod_tpu/utils/tracing.py",\n'
+               "}\n")
+    got = _finalize_pass(
+        ZeroCostGatePass(),
+        {"horovod_tpu/common/env.py": env_src,
+         "horovod_tpu/utils/tracing.py": "_TRACER = None\n"},
+        _zerocost_project())
+    msgs = " ".join(f.message for f in got)
+    assert "never consulted" in msgs
+    assert "no guarded hook" in msgs
+
+
+def test_zero_cost_gates_unregistered_trio_is_flagged():
+    rogue = ("from ..common import env as env_schema\n"
+             "_REC = None\n"
+             "def enabled():\n"
+             "    return env_schema.get_bool(env_schema.HOROVOD_ROGUE)\n")
+    got = _finalize_pass(
+        ZeroCostGatePass(),
+        {"horovod_tpu/utils/tracing.py": _TRACING_FIXTURE,
+         "horovod_tpu/utils/rogue.py": rogue},
+        _zerocost_project())
+    assert len(got) == 1
+    assert "not registered in" in got[0].message
+    assert got[0].path == "horovod_tpu/utils/rogue.py"
+
+
+_COLLECTIVES_FIXTURE = ("_PLANS = {}\n"
+                        "def invalidate_fused_plans(reason=None):\n"
+                        "    _PLANS.clear()\n")
+
+
+def _funnel_project(**sources):
+    p = _project()
+    p.plan_key_sources = sources or {
+        "fusion_threshold": ("attr:fusion_threshold",)}
+    p.plan_key_sources_line = 1
+    return p
+
+
+def test_invalidation_funnel_flags_unfunneled_write():
+    q = ("class Queue:\n"
+         "    def __init__(self):\n"
+         "        self.fusion_threshold = 0\n"
+         "    def set_fusion(self, v):\n"
+         "        self.fusion_threshold = v\n")
+    got = _finalize_pass(
+        InvalidationFunnelPass(),
+        {"horovod_tpu/ops/collectives.py": _COLLECTIVES_FIXTURE,
+         "horovod_tpu/ops/queue.py": q},
+        _funnel_project())
+    # the __init__ write is constructor-exempt; only set_fusion fires
+    assert len(got) == 1 and got[0].rule == "invalidation-funnel"
+    assert "fusion_threshold" in got[0].message
+    assert got[0].line == 5
+
+
+def test_invalidation_funnel_clean_when_funneled_transitively():
+    q = ("from . import collectives as collectives_mod\n"
+         "class Queue:\n"
+         "    def set_fusion(self, v):\n"
+         "        self.fusion_threshold = v\n"
+         "        self._invalidate()\n"
+         "    def _invalidate(self):\n"
+         "        collectives_mod.invalidate_fused_plans()\n")
+    assert _finalize_pass(
+        InvalidationFunnelPass(),
+        {"horovod_tpu/ops/collectives.py": _COLLECTIVES_FIXTURE,
+         "horovod_tpu/ops/queue.py": q},
+        _funnel_project()) == []
+
+
+def test_invalidation_funnel_orphaned_watch():
+    # an attr: spec whose attribute exists nowhere means the registry
+    # rotted (knob renamed/removed) — reported at the declaration
+    got = _finalize_pass(
+        InvalidationFunnelPass(),
+        {"horovod_tpu/ops/collectives.py": _COLLECTIVES_FIXTURE},
+        _funnel_project(ghost=("attr:ghost_knob",)))
+    assert len(got) == 1
+    assert "ghost_knob" in got[0].message
+    assert "renamed or removed" in got[0].message
+
+
+_WIRE_FIXTURE = (
+    'KIND_SUBMIT = b"\\x01s"\n'
+    'KIND_AGG = b"\\x01a"\n'
+    "def encode_submission(e):\n"
+    "    return KIND_SUBMIT + e\n"
+    "def decode_submission(raw):\n"
+    "    return raw[len(KIND_SUBMIT):]\n"
+    "def encode_aggregate(e):\n"
+    "    return KIND_AGG + e\n"
+    "def decode_aggregate(raw):\n"
+    "    return raw[len(KIND_AGG):]\n")
+
+_CTRL_PREFIX = (
+    "import json\n"
+    "from . import wire as wire_mod\n"
+    "class Ctl:\n"
+    '    SAME_AS_LAST = b"="\n'
+    "    def send(self, e):\n"
+    "        self.client.put(wire_mod.encode_submission(e))\n"
+    "        self.client.put(wire_mod.encode_aggregate(e))\n"
+    "        self.client.put(self.SAME_AS_LAST)\n"
+    "    def recv_agg(self, raw):\n"
+    "        if raw[:1] == self.SAME_AS_LAST:\n"
+    "            return None\n"
+    "        return wire_mod.decode_aggregate(raw)\n")
+
+
+def _protocol(ctrl, wire=_WIRE_FIXTURE):
+    return _finalize_pass(
+        ProtocolCoveragePass(),
+        {"horovod_tpu/ops/wire.py": wire,
+         "horovod_tpu/ops/controller.py": ctrl})
+
+
+def test_protocol_submission_decoder_needs_marker_arm():
+    violating = _CTRL_PREFIX + (
+        "    def recv(self, raw):\n"
+        "        return wire_mod.decode_submission(raw)\n")
+    got = _protocol(violating)
+    assert len(got) == 1 and got[0].rule == "protocol-coverage"
+    assert "SAME_AS_LAST" in got[0].message and "recv" in got[0].message
+    clean = _CTRL_PREFIX + (
+        "    def recv(self, raw):\n"
+        "        if raw[:1] == self.SAME_AS_LAST:\n"
+        "            return None\n"
+        "        return wire_mod.decode_submission(raw)\n")
+    assert _protocol(clean) == []
+
+
+def test_protocol_uncovered_kind_is_flagged():
+    # nothing accepts aggregates: the declared kind is an uncovered
+    # (state, frame) pair, reported at the wire declaration
+    ctrl = ("from . import wire as wire_mod\n"
+            "class Ctl:\n"
+            '    SAME_AS_LAST = b"="\n'
+            "    def send(self, e):\n"
+            "        self.client.put(wire_mod.encode_submission(e))\n"
+            "        self.client.put(wire_mod.encode_aggregate(e))\n"
+            "        self.client.put(self.SAME_AS_LAST)\n"
+            "    def recv(self, raw):\n"
+            "        if raw[:1] == self.SAME_AS_LAST:\n"
+            "            return None\n"
+            "        return wire_mod.decode_submission(raw)\n")
+    got = _protocol(ctrl)
+    assert len(got) == 1
+    assert "KIND_AGG" in got[0].message
+    assert "no controller handler accepts" in got[0].message
+    assert got[0].path == "horovod_tpu/ops/wire.py"
+
+
+def test_protocol_mixed_mode_inbox_needs_aggregate_arm():
+    inbox_v1 = (
+        "    def inbox(self, raw):\n"
+        "        if raw[:1] == self.SAME_AS_LAST:\n"
+        "            return None\n"
+        '        if raw[:1] == b"\\x01":\n'
+        "            return wire_mod.decode_submission(raw)\n"
+        "        return json.loads(raw)\n")
+    got = _protocol(_CTRL_PREFIX + inbox_v1)
+    assert len(got) == 1
+    assert "mixed-mode" in got[0].message and "aggregate" in got[0].message
+    # json.loads on a *slice* parses an embedded payload (the marker's
+    # timestamp suffix), not a v1 frame — must not make inbox mixed-mode
+    inbox_suffix = inbox_v1.replace("return json.loads(raw)",
+                                    "return json.loads(raw[1:])")
+    assert _protocol(_CTRL_PREFIX + inbox_suffix) == []
+
+
+def test_protocol_response_decoder_needs_json_fallback():
+    wire = _WIRE_FIXTURE + (
+        'KIND_RESP = b"\\x01r"\n'
+        "class ResponseEncoder:\n"
+        "    def encode(self, m):\n"
+        "        return KIND_RESP + m\n"
+        "class ResponseDecoder:\n"
+        "    def decode(self, raw):\n"
+        "        return raw[len(KIND_RESP):]\n")
+    base = _CTRL_PREFIX + (
+        "    def __init__(self):\n"
+        "        self._enc = wire_mod.ResponseEncoder()\n"
+        "        self._dec = wire_mod.ResponseDecoder()\n"
+        "    def push(self, m):\n"
+        "        self.client.put(self._enc.encode(m))\n"
+        "    def recv(self, raw):\n"
+        "        if raw[:1] == self.SAME_AS_LAST:\n"
+        "            return None\n"
+        "        return wire_mod.decode_submission(raw)\n")
+    violating = base + (
+        "    def poll(self, raw):\n"
+        "        return self._dec.decode(raw)\n")
+    got = _protocol(violating, wire)
+    assert len(got) == 1
+    assert "json.loads fallback" in got[0].message
+    assert "poll" in got[0].message
+    clean = base + (
+        "    def poll(self, raw):\n"
+        "        try:\n"
+        "            return self._dec.decode(raw)\n"
+        "        except ValueError:\n"
+        "            return json.loads(raw)\n")
+    assert _protocol(clean, wire) == []
+
+
+_LOCK_PAIR_HEAD = (
+    "from ..utils import lockcheck\n"
+    "class Pair:\n"
+    "    def __init__(self):\n"
+    '        self._la = lockcheck.make_lock("fix.a")\n'
+    '        self._lb = lockcheck.make_lock("fix.b")\n')
+
+
+def test_lock_order_pass_flags_cycle():
+    src = _LOCK_PAIR_HEAD + (
+        "    def forward(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def backward(self):\n"
+        "        with self._lb:\n"
+        "            with self._la:\n"
+        "                pass\n")
+    got = _finalize_pass(LockOrderPass(),
+                         {"horovod_tpu/ops/pair.py": src})
+    assert len(got) == 1 and got[0].rule == "lock-order"
+    assert "cycle" in got[0].message
+    assert "fix.a" in got[0].message and "fix.b" in got[0].message
+
+
+def test_lock_order_pass_clean_graph_includes_call_edges():
+    # consistent order, one acquisition through a call made while
+    # holding: no finding, and the exported graph carries the edge
+    src = _LOCK_PAIR_HEAD + (
+        "    def outer(self):\n"
+        "        with self._la:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lb:\n"
+        "            pass\n")
+    rule = LockOrderPass()
+    assert _finalize_pass(rule, {"horovod_tpu/ops/pair.py": src}) == []
+    assert rule.graph["nodes"] == ["fix.a", "fix.b"]
+    assert [(e["from"], e["to"]) for e in rule.graph["edges"]] \
+        == [("fix.a", "fix.b")]
+
+
+def test_runtime_lockcheck_edges_subset_of_static_graph():
+    """Runtime ⊆ static: every held->acquired pair the live auditor has
+    observed during this suite must appear in the static lock-order
+    graph — the prover's over-approximation never misses a real
+    acquisition order. (Ad-hoc test locks are filtered out by node
+    name; only statically-registered locks are comparable.)"""
+    graph = build_lock_graph(_REPO)
+    nodes = set(graph["nodes"])
+    assert nodes, "static graph found no registered locks"
+    static = {(e["from"], e["to"]) for e in graph["edges"]}
+    runtime = {tuple(e) for e in lockcheck.edges()
+               if e[0] in nodes and e[1] in nodes}
+    assert runtime <= static, (
+        "runtime lock edges missing from the static graph: "
+        f"{sorted(runtime - static)}")
+
+
+# ------------------------------------------------- stale pragmas + baseline
+
+
+def test_stale_pragma_flagged_and_optout():
+    src = "x = 1  " + _PRAGMA + "lock-discipline\n"
+    got = _findings(src)
+    assert [f.rule for f in got] == ["stale-pragma"]
+    assert "suppresses nothing" in got[0].message
+    # the literal stale-pragma tag opts a line out (platform-dependent
+    # pragmas that legitimately suppress nothing on this run)
+    optout = "x = 1  " + _PRAGMA + "lock-discipline,stale-pragma\n"
+    assert _findings(optout) == []
+
+
+def test_finding_fingerprint_stable_across_line_drift():
+    from tools.hvdlint import Finding
+
+    a = Finding("lock-discipline", "horovod_tpu/ops/x.py", 10, "msg 3 a")
+    b = Finding("lock-discipline", "horovod_tpu/ops/x.py", 99, "msg 7 a")
+    c = Finding("lock-discipline", "horovod_tpu/ops/y.py", 10, "msg 3 a")
+    assert a.fingerprint == b.fingerprint  # line + digits normalized out
+    assert a.fingerprint != c.fingerprint  # path is identity
+    assert a.to_dict()["fingerprint"] == a.fingerprint
+
+
+def test_cli_baseline_and_diff(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(LOCK_VIOLATION)
+    base = tmp_path / "base.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", str(bad),
+             "--root", _REPO, *extra],
+            cwd=_REPO, capture_output=True, text=True, timeout=300)
+
+    # record the current findings as the baseline (still exits 1: the
+    # run itself was judged against an empty baseline)
+    proc = run("--write-baseline", str(base))
+    assert proc.returncode == 1
+    assert json.loads(base.read_text())[0]["rule"] == "lock-discipline"
+    # every finding covered by the baseline -> exit 0, --diff shows none
+    proc = run("--baseline", str(base), "--diff", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+    # line drift must not resurrect baselined findings
+    bad.write_text("# pushed down a line\n" + LOCK_VIOLATION)
+    proc = run("--baseline", str(base), "--diff")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a NEW violation is reported alone under --diff and fails the run
+    bad.write_text(LOCK_VIOLATION + "%s _lock\ny = 2\n" % _GB)
+    proc = run("--baseline", str(base), "--diff", "--json")
+    assert proc.returncode == 1
+    shown = json.loads(proc.stdout)
+    assert len(shown) == 1 and "dangling" in shown[0]["message"]
+
+
+def test_cli_diff_requires_baseline(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--diff", "tools"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 2
+    assert "--diff requires --baseline" in proc.stderr
+
+
+def test_cli_lock_graph_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--lock-graph"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    graph = json.loads(proc.stdout)
+    assert "metrics.registry" in graph["nodes"]
+    assert all({"from", "to", "at"} <= set(e) for e in graph["edges"])
 
 
 # ------------------------------------------------------------ lockcheck

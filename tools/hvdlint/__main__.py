@@ -1,6 +1,19 @@
-"""CLI: ``python -m tools.hvdlint [paths] [--json] [--root DIR]``.
+"""CLI: ``python -m tools.hvdlint [paths] [--json] [--root DIR]
+[--baseline FILE [--diff]] [--write-baseline FILE] [--lock-graph]``.
 
-Exit status 0 when clean, 1 when any finding survives pragmas.
+Exit-code contract:
+
+- ``0`` — clean, or every finding is already present in the supplied
+  ``--baseline`` (matched by fingerprint: rule + path + normalized
+  message, stable across line drift);
+- ``1`` — at least one finding not covered by the baseline;
+- ``2`` — usage error (argparse).
+
+``--write-baseline FILE`` records the current findings as the new
+baseline (and still exits per the contract above, judged against
+``--baseline`` if one was given, else against zero). ``--diff`` limits
+the report to findings absent from the baseline. ``--lock-graph``
+prints the static lock acquisition-order graph as JSON and exits 0.
 """
 
 from __future__ import annotations
@@ -13,6 +26,16 @@ from .core import find_repo_root, run_lint
 from .rules import make_rules
 
 
+def _load_baseline(path: str) -> set:
+    """Fingerprints from a baseline file (a JSON array of finding dicts,
+    or ``{"findings": [...]}``)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    return {f["fingerprint"] for f in data if "fingerprint" in f}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="hvdlint",
@@ -23,20 +46,51 @@ def main(argv=None) -> int:
                     help="emit findings as a JSON array")
     ap.add_argument("--root", default=None,
                     help="repository root (default: ascend from first path)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="known-findings file; only findings absent from "
+                         "it fail the run (exit 1)")
+    ap.add_argument("--diff", action="store_true",
+                    help="with --baseline: report only new findings")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write the current findings as a baseline file")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the static lock-order graph as JSON and "
+                         "exit")
     args = ap.parse_args(argv)
 
     paths = args.paths or ["horovod_tpu"]
     root = args.root or find_repo_root(paths[0])
+
+    if args.lock_graph:
+        from .passes import build_lock_graph
+
+        print(json.dumps(build_lock_graph(root), indent=2))
+        return 0
+
+    if args.diff and not args.baseline:
+        ap.error("--diff requires --baseline")
+
     rules = make_rules()
     findings = run_lint(paths, root=root, rules=rules)
+
+    baseline = _load_baseline(args.baseline) if args.baseline else set()
+    new = [f for f in findings if f.fingerprint not in baseline]
+    shown = new if (args.diff and args.baseline) else findings
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump([fd.to_dict() for fd in findings], f, indent=2)
+            f.write("\n")
+
     if args.as_json:
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+        print(json.dumps([f.to_dict() for f in shown], indent=2))
     else:
-        for f in findings:
+        for f in shown:
             print(f)
-        print(f"hvdlint: {len(findings)} finding(s), "
+        suffix = f" ({len(new)} not in baseline)" if args.baseline else ""
+        print(f"hvdlint: {len(shown)} finding(s){suffix}, "
               f"{len(rules)} rule(s) active", file=sys.stderr)
-    return 1 if findings else 0
+    return 1 if new else 0
 
 
 if __name__ == "__main__":

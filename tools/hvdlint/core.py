@@ -20,9 +20,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -31,6 +32,10 @@ PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 ENV_SCHEMA_REL = "horovod_tpu/common/env.py"
 FAULTS_REL = "horovod_tpu/utils/faults.py"
 FLIGHTREC_REL = "horovod_tpu/utils/flightrec.py"
+COLLECTIVES_REL = "horovod_tpu/ops/collectives.py"
+
+#: the engine-level rule id for pragmas that suppress nothing
+STALE_PRAGMA_RULE = "stale-pragma"
 
 
 @dataclasses.dataclass
@@ -40,8 +45,20 @@ class Finding:
     line: int
     message: str
 
+    @property
+    def fingerprint(self) -> str:
+        """Stable id for baseline comparison: rule + path + the message
+        with digit runs collapsed, so a finding keeps its identity when
+        unrelated edits shift line numbers."""
+        norm = re.sub(r"\d+", "#", self.message)
+        digest = hashlib.sha1(
+            f"{self.rule}|{self.path}|{norm}".encode("utf-8")).hexdigest()
+        return digest[:12]
+
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
 
     def __str__(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
@@ -122,6 +139,61 @@ def _flight_categories(tree: ast.Module) -> "tuple[Dict[str, int], List[str]]":
     return names, dups
 
 
+def _gated_subsystems(tree: ast.Module) -> "tuple[Dict[str, str], int]":
+    """The ``GATED_SUBSYSTEMS`` registry in common/env.py: master-switch
+    constant -> gated module relpath. Keys are the schema constant Names
+    (resolved through the module's own ``NAME = "value"`` assignments),
+    so the zero-cost prover derives its gate list from the schema, never
+    from a hand-kept table. Returns ({} , 1) when absent."""
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "GATED_SUBSYSTEMS" \
+                and isinstance(node.value, ast.Dict):
+            out: Dict[str, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                key = None
+                if isinstance(k, ast.Name):
+                    key = consts.get(k.id, k.id)
+                elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    key = k.value
+                if key and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    out[key] = v.value
+            return out, node.lineno
+    return {}, 1
+
+
+def _plan_key_sources(tree: ast.Module) -> "tuple[Dict[str, Tuple[str, ...]], int]":
+    """The ``PLAN_KEY_SOURCES`` registry in ops/collectives.py:
+    plan-key ingredient -> tuple of ``attr:<name>`` / ``env:<CONST>``
+    watch specs. Returns ({}, 1) when absent."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "PLAN_KEY_SOURCES" \
+                and isinstance(node.value, ast.Dict):
+            out: Dict[str, Tuple[str, ...]] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                key = k.value if isinstance(k, ast.Constant) \
+                    and isinstance(k.value, str) else None
+                if key is None or not isinstance(v, (ast.Tuple, ast.List)):
+                    continue
+                specs = tuple(e.value for e in v.elts
+                              if isinstance(e, ast.Constant)
+                              and isinstance(e.value, str))
+                out[key] = specs
+            return out, node.lineno
+    return {}, 1
+
+
 def _fault_sites(tree: ast.Module) -> Set[str]:
     """The declared ``SITES`` tuple in utils/faults.py."""
     for node in tree.body:
@@ -155,6 +227,14 @@ class Project:
         self.flight_category_dups: List[str] = []
         # doc filename -> full text (for presence checks)
         self.docs: Dict[str, str] = {}
+        # master-switch env value -> gated module relpath, from the
+        # GATED_SUBSYSTEMS registry in common/env.py (zero-cost prover)
+        self.gated_subsystems: Dict[str, str] = {}
+        self.gated_subsystems_line: int = 1
+        # plan-key ingredient -> watch specs, from PLAN_KEY_SOURCES in
+        # ops/collectives.py (invalidation-funnel pass)
+        self.plan_key_sources: Dict[str, Tuple[str, ...]] = {}
+        self.plan_key_sources_line: int = 1
 
     @classmethod
     def from_root(cls, root: str) -> "Project":
@@ -165,6 +245,14 @@ class Project:
                 tree = ast.parse(f.read(), filename=schema)
             p.env_constants = _module_str_constants(tree, "HOROVOD_")
             p.env_constant_lines = _env_constant_lines(tree)
+            p.gated_subsystems, p.gated_subsystems_line = \
+                _gated_subsystems(tree)
+        collectives = os.path.join(root, COLLECTIVES_REL)
+        if os.path.exists(collectives):
+            with open(collectives, encoding="utf-8") as f:
+                p.plan_key_sources, p.plan_key_sources_line = \
+                    _plan_key_sources(
+                        ast.parse(f.read(), filename=collectives))
         faults = os.path.join(root, FAULTS_REL)
         if os.path.exists(faults):
             with open(faults, encoding="utf-8") as f:
@@ -217,19 +305,84 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
                         yield os.path.join(dirpath, fn)
 
 
+def _suppress(ctx: FileContext, rule_name: str, line: int,
+              consumed: Set[tuple]) -> bool:
+    """Apply a line pragma to one finding, recording which tag did the
+    suppressing so stale-pragma reporting can tell used tags from dead
+    ones."""
+    tags = ctx.pragmas.get(line)
+    if not tags:
+        return False
+    if rule_name in tags:
+        consumed.add((ctx.path, line, rule_name))
+        return True
+    if "all" in tags:
+        consumed.add((ctx.path, line, "all"))
+        return True
+    return False
+
+
+def _stale_pragma_findings(contexts: Dict[str, FileContext],
+                           consumed: Set[tuple]) -> List[Finding]:
+    """A pragma tag that suppressed no finding this run is itself a
+    finding: disables must not outlive their violation. The literal tag
+    ``stale-pragma`` opts a line out (and is never reported itself) —
+    for pragmas that guard findings which only fire on other platforms
+    or rule subsets."""
+    out: List[Finding] = []
+    for path in sorted(contexts):
+        ctx = contexts[path]
+        for line in sorted(ctx.pragmas):
+            tags = ctx.pragmas[line]
+            if STALE_PRAGMA_RULE in tags:
+                continue
+            for tag in sorted(tags):
+                if (path, line, tag) not in consumed:
+                    out.append(Finding(
+                        STALE_PRAGMA_RULE, path, line,
+                        f"pragma 'disable={tag}' suppresses nothing on "
+                        "this line — remove it (or spell the rule it is "
+                        "meant to silence)"))
+    return out
+
+
+def _finalize_all(active: list, project: Project,
+                  contexts: Dict[str, FileContext],
+                  consumed: Set[tuple]) -> List[Finding]:
+    """Run every rule's project-level pass, honoring line pragmas for
+    findings that land in a file seen this run (project-level findings
+    are suppressible exactly like per-file ones)."""
+    out: List[Finding] = []
+    for rule in active:
+        finalize = getattr(rule, "finalize", None)
+        if finalize is None:
+            continue
+        for fd in finalize(project):
+            ctx = contexts.get(fd.path)
+            if ctx is not None and _suppress(ctx, rule.name, fd.line,
+                                            consumed):
+                continue
+            out.append(fd)
+    return out
+
+
 def lint_source(source: str, path: str, project: Project,
                 rules: Optional[list] = None) -> List[Finding]:
     """Lint one in-memory source string (tests feed fixture snippets
-    through this; ``path`` decides which per-path rules apply)."""
+    through this; ``path`` decides which per-path rules apply). Per-file
+    findings plus stale-pragma findings; project-level ``finalize``
+    passes do not run here — call them on the rule instance."""
     from . import rules as rules_mod
 
     active = rules if rules is not None else rules_mod.make_rules()
     ctx = FileContext(path, source, project)
+    consumed: Set[tuple] = set()
     out: List[Finding] = []
     for rule in active:
         for f in rule.check_file(ctx):
-            if not ctx.suppressed(rule.name, f.line):
+            if not _suppress(ctx, rule.name, f.line, consumed):
                 out.append(f)
+    out.extend(_stale_pragma_findings({ctx.path: ctx}, consumed))
     return out
 
 
@@ -248,6 +401,8 @@ def run_lint(paths: Iterable[str], root: Optional[str] = None,
     project = Project.from_root(root)
     active = rules if rules is not None else rules_mod.make_rules()
     findings: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
+    consumed: Set[tuple] = set()
     for path in iter_py_files(paths):
         with open(path, encoding="utf-8") as f:
             source = f.read()
@@ -260,13 +415,12 @@ def run_lint(paths: Iterable[str], root: Optional[str] = None,
             findings.append(Finding("parse", rel, e.lineno or 0,
                                     f"syntax error: {e.msg}"))
             continue
+        contexts[ctx.path] = ctx
         for rule in active:
             for fd in rule.check_file(ctx):
-                if not ctx.suppressed(rule.name, fd.line):
+                if not _suppress(ctx, rule.name, fd.line, consumed):
                     findings.append(fd)
-    for rule in active:
-        finalize = getattr(rule, "finalize", None)
-        if finalize is not None:
-            findings.extend(finalize(project))
+    findings.extend(_finalize_all(active, project, contexts, consumed))
+    findings.extend(_stale_pragma_findings(contexts, consumed))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

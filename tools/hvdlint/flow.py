@@ -1,0 +1,289 @@
+"""Whole-program analysis infrastructure for hvdlint's dataflow tier.
+
+The per-file rules in ``rules.py`` see one tree at a time; the passes
+under ``passes/`` reason about the package as a whole: who imports whom,
+which function a call resolves to, which attribute holds what. This
+module is the shared substrate — a :class:`ModuleInfo` per source file
+(import aliases, function table, class table, module-global None
+handles) plus a best-effort call resolver and reachability helper.
+
+Resolution is deliberately conservative and purely syntactic:
+
+- ``from ..common import env as env_schema`` / ``from . import megaplan
+  as megaplan_mod`` map the alias to a package-relative module path
+  (function-local imports included — the package uses them to break
+  cycles);
+- ``from ..ops.collectives import invalidate_fused_plans`` maps the bare
+  name to a (module, symbol) pair;
+- a call resolves through ``self.method`` (same class), a bare name
+  (same module or symbol import), or ``alias.func`` (imported module).
+
+Anything unresolvable resolves to ``None`` and the passes treat it as
+opaque. Everything here is stdlib ``ast`` only, like the rest of
+hvdlint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+PACKAGE = "horovod_tpu"
+
+
+def dotted_to_relpath(dotted: str, known: Set[str]) -> Optional[str]:
+    """``horovod_tpu.ops.megaplan`` -> ``horovod_tpu/ops/megaplan.py``,
+    preferring a module file over a package ``__init__.py``; None when
+    neither is a known linted file."""
+    base = dotted.replace(".", "/")
+    for cand in (base + ".py", base + "/__init__.py"):
+        if cand in known:
+            return cand
+    return None
+
+
+def _resolve_relative(current: str, level: int, module: str) -> str:
+    """Dotted absolute module for a relative import found in ``current``
+    (a repo-relative path like ``horovod_tpu/ops/queue.py``)."""
+    parts = current.replace("\\", "/").split("/")
+    # drop the filename; __init__.py's package is its own directory
+    parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    if module:
+        parts = parts + module.split(".")
+    return ".".join(p for p in parts if p)
+
+
+class FuncInfo:
+    """One function or method: where it lives and its AST node."""
+
+    __slots__ = ("module", "qualname", "name", "cls", "node")
+
+    def __init__(self, module: str, qualname: str, name: str,
+                 cls: Optional[str], node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.name = name
+        self.cls = cls
+        self.node = node
+
+
+class ModuleInfo:
+    """Parsed cross-reference facts for one source file."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path.replace("\\", "/")
+        self.tree = tree
+        # alias -> dotted module ("megaplan_mod" -> "horovod_tpu.ops.megaplan")
+        self.module_aliases: Dict[str, str] = {}
+        # bare name -> (dotted module, symbol)
+        self.symbol_imports: Dict[str, Tuple[str, str]] = {}
+        # "name" or "Class.name" -> FuncInfo
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # module-level NAME = None (or annotated with a None default)
+        self.global_none: Set[str] = set()
+        # every module-level assignment target name
+        self.global_names: Set[str] = set()
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = _resolve_relative(self.path, node.level,
+                                             node.module or "")
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    # "from X import y" may bind a submodule or a symbol;
+                    # record both readings, resolution picks whichever the
+                    # file set can satisfy
+                    self.module_aliases.setdefault(
+                        alias, f"{base}.{a.name}" if base else a.name)
+                    self.symbol_imports[alias] = (base, a.name)
+        for node in self.tree.body:
+            self._collect_scope(node, cls=None)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.global_names.add(t.id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                self.global_names.add(node.target.id)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                self.global_none.add(node.targets[0].id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                self.global_none.add(node.target.id)
+
+    def _collect_scope(self, node: ast.AST, cls: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{cls}.{node.name}" if cls else node.name
+            self.functions[qual] = FuncInfo(self.path, qual, node.name,
+                                            cls, node)
+        elif isinstance(node, ast.ClassDef):
+            self.classes[node.name] = node
+            for child in node.body:
+                self._collect_scope(child, cls=node.name)
+
+
+def module_info(path: str, tree: ast.Module) -> ModuleInfo:
+    """Memoized ModuleInfo — all passes in a run share one FileContext
+    per file, so caching on the tree object itself is safe and keeps the
+    four dataflow passes from re-indexing every module four times."""
+    cached = getattr(tree, "_hvdlint_modinfo", None)
+    if cached is not None and cached.path == path.replace("\\", "/"):
+        return cached
+    info = ModuleInfo(path, tree)
+    try:
+        tree._hvdlint_modinfo = info  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    return info
+
+
+class Workspace:
+    """The accumulated package: relpath -> ModuleInfo, plus resolvers."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = dict(modules)
+        self.paths: Set[str] = set(self.modules)
+
+    def module_for_dotted(self, dotted: str) -> Optional[ModuleInfo]:
+        rel = dotted_to_relpath(dotted, self.paths)
+        return self.modules.get(rel) if rel else None
+
+    def resolve_alias(self, mod: ModuleInfo, alias: str) \
+            -> Optional[ModuleInfo]:
+        """The ModuleInfo an alias refers to, if it names a linted
+        module (``megaplan_mod`` -> ops/megaplan's info)."""
+        dotted = mod.module_aliases.get(alias)
+        if dotted:
+            target = self.module_for_dotted(dotted)
+            if target is not None:
+                return target
+        sym = mod.symbol_imports.get(alias)
+        if sym:
+            target = self.module_for_dotted(f"{sym[0]}.{sym[1]}")
+            if target is not None:
+                return target
+        return None
+
+    def resolve_call(self, call: ast.Call, caller: FuncInfo,
+                     mod: ModuleInfo) -> Optional[FuncInfo]:
+        """Best-effort static resolution of one call site."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # same-module function or class constructor
+            if fn.id in mod.functions:
+                return mod.functions[fn.id]
+            if fn.id in mod.classes:
+                return mod.functions.get(f"{fn.id}.__init__")
+            sym = mod.symbol_imports.get(fn.id)
+            if sym:
+                target = self.module_for_dotted(sym[0])
+                if target is not None:
+                    if sym[1] in target.functions:
+                        return target.functions[sym[1]]
+                    if sym[1] in target.classes:
+                        return target.functions.get(f"{sym[1]}.__init__")
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and caller.cls:
+                    hit = mod.functions.get(f"{caller.cls}.{fn.attr}")
+                    if hit is not None:
+                        return hit
+                    return None
+                target = self.resolve_alias(mod, base.id)
+                if target is not None:
+                    if fn.attr in target.functions:
+                        return target.functions[fn.attr]
+                    if fn.attr in target.classes:
+                        return target.functions.get(f"{fn.attr}.__init__")
+        return None
+
+    def iter_functions(self) -> Iterable[Tuple[ModuleInfo, FuncInfo]]:
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                yield mod, fi
+
+    def callees(self, mod: ModuleInfo, fi: FuncInfo) -> List[FuncInfo]:
+        out = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                hit = self.resolve_call(node, fi, mod)
+                if hit is not None:
+                    out.append(hit)
+        return out
+
+    def reaches(self, start: FuncInfo,
+                targets: Set[Tuple[str, str]],
+                max_depth: int = 8) -> bool:
+        """BFS over resolvable call edges: does ``start`` (or anything it
+        calls, transitively) hit a target ``(module_path, qualname)``?"""
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [start]
+        depth = 0
+        while frontier and depth <= max_depth:
+            nxt = []
+            for fi in frontier:
+                key = (fi.module, fi.qualname)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if key in targets:
+                    return True
+                mod = self.modules.get(fi.module)
+                if mod is None:
+                    continue
+                nxt.extend(self.callees(mod, fi))
+            frontier = nxt
+            depth += 1
+        return False
+
+
+def enclosing_functions(tree: ast.Module) \
+        -> List[Tuple[Optional[str], ast.AST]]:
+    """(class name or None, function node) pairs, one per def."""
+    out: List[Tuple[Optional[str], ast.AST]] = []
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                visit(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            else:
+                visit(child, cls)
+
+    visit(tree, None)
+    return out
+
+
+def call_name(call: ast.Call) -> str:
+    """Flat dotted name of a call target (best effort, for matching)."""
+    parts: List[str] = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))

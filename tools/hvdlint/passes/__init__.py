@@ -1,0 +1,27 @@
+"""Whole-program dataflow passes (hvdlint v2).
+
+Unlike the per-file rules in ``rules.py``, each pass here accumulates
+every package tree during ``check_file`` and does its real work in
+``finalize``, reasoning over the call/attribute graph built by
+``flow.py``. They plug into the same engine: same ``Finding`` type, same
+pragma mechanics (the engine applies pragmas to finalize findings via
+the retained per-file contexts).
+
+- :class:`~.zerocost.ZeroCostGatePass` — proves every hook of the
+  env-gated subsystems does no work before its is-None/enabled() gate;
+  the subsystem list comes from ``GATED_SUBSYSTEMS`` in common/env.py.
+- :class:`~.funnel.InvalidationFunnelPass` — proves every write to a
+  plan-key ingredient (``PLAN_KEY_SOURCES`` in ops/collectives.py)
+  reaches the invalidation funnel.
+- :class:`~.protocol.ProtocolCoveragePass` — extracts the wire-frame
+  state machines from ops/wire.py + ops/controller.py and reports
+  uncovered (state, frame-kind) pairs.
+- :class:`~.lockgraph.LockOrderPass` — builds the static lock
+  acquisition-order graph, flags cycles, and exports the graph JSON the
+  runtime lockcheck consistency test asserts against.
+"""
+
+from .funnel import InvalidationFunnelPass  # noqa: F401
+from .lockgraph import LockOrderPass, build_lock_graph  # noqa: F401
+from .protocol import ProtocolCoveragePass  # noqa: F401
+from .zerocost import ZeroCostGatePass  # noqa: F401

@@ -1,0 +1,244 @@
+"""Pass (b): invalidation-funnel completeness.
+
+Every fused-chunk plan and megaplan capture is keyed by a set of
+*ingredients* — fusion threshold, chunk granularity, wire mode, hier
+topology, staging slots, elastic generation, layout digest. Mutating an
+ingredient without routing through ``invalidate_fused_plans()`` /
+``invalidate_megaplan()`` silently replays a stale plan. The ingredient
+set is declared next to the key builders as ``PLAN_KEY_SOURCES`` in
+ops/collectives.py (``attr:<name>`` watches attribute writes,
+``env:<CONST>`` watches ``os.environ[...]`` writes) and this pass proves
+three things:
+
+1. **Funnel completeness** — every package write to a watched ingredient
+   happens in a function that (transitively, through statically
+   resolvable calls) invokes one of the funnel entry points.
+   Constructors are exempt (``__init__``, and writes to an object the
+   function itself just created): building a fresh config is not
+   mutating a live one. The analysis is function-granular, not
+   path-sensitive: the funnel call must appear in the write's enclosing
+   function or its callees.
+2. **No orphaned watches** — an ``attr:`` spec whose attribute appears
+   nowhere in the package, or an ``env:`` spec whose constant no
+   key-builder module reads, means the registry rotted (e.g. the knob
+   was renamed); that is a finding at the registry declaration.
+3. **No unwatched key elements** — any ``key = (_PLAN_KEY, ...)`` tuple
+   element that calls a local helper reading an env constant (the
+   ``_plan_epoch()`` pattern) must have a matching ``env:`` spec, so a
+   new key ingredient cannot be added without declaring its watch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import flow
+from ..core import COLLECTIVES_REL, FileContext, Finding, Project
+
+_FUNNELS = ("invalidate_fused_plans", "invalidate_megaplan")
+_ENV_READERS = {"get_bool", "get_int", "get_float", "get_str", "get",
+                "getenv"}
+
+
+def _is_os_environ(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def _env_key_name(node: ast.expr) -> Optional[str]:
+    """The constant name an ``os.environ[...]`` subscript indexes by."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fresh_locals(fn: ast.AST, ws: flow.Workspace, mod: flow.ModuleInfo,
+                  fi: flow.FuncInfo) -> Set[str]:
+    """Names bound in this function to an object it constructed itself
+    (``c = cls()`` / ``cfg = RuntimeConfig()``): writing their attributes
+    is initialization, not mutation of live plan-key state."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        ctor = False
+        if isinstance(call.func, ast.Name) and call.func.id == "cls":
+            ctor = True
+        else:
+            hit = ws.resolve_call(call, fi, mod)
+            ctor = hit is not None and hit.name == "__init__"
+        if ctor:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class InvalidationFunnelPass:
+    """See module docstring."""
+
+    name = "invalidation-funnel"
+
+    def __init__(self):
+        self._trees: Dict[str, ast.Module] = {}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.in_package():
+            self._trees[ctx.path] = ctx.tree
+        return ()
+
+    # ------------------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        sources = project.plan_key_sources
+        if not sources or not self._trees:
+            return
+        ws = flow.Workspace({p: flow.module_info(p, t)
+                             for p, t in self._trees.items()})
+        attr_watch: Dict[str, str] = {}
+        env_watch: Dict[str, str] = {}
+        for ing, specs in sources.items():
+            for spec in specs:
+                kind, _, val = spec.partition(":")
+                if kind == "attr":
+                    attr_watch[val] = ing
+                elif kind == "env":
+                    env_watch[val] = ing
+        targets = {(m.path, fi.qualname)
+                   for m, fi in ws.iter_functions()
+                   if fi.name in _FUNNELS}
+
+        for mod in ws.modules.values():
+            for fi in mod.functions.values():
+                yield from self._check_function(ws, mod, fi, attr_watch,
+                                                env_watch, targets)
+        yield from self._registry_cross_check(ws, project, attr_watch,
+                                              env_watch)
+
+    # -- write sites ---------------------------------------------------
+
+    def _check_function(self, ws, mod, fi, attr_watch, env_watch,
+                        targets) -> Iterable[Finding]:
+        if fi.name == "__init__":
+            return
+        writes: List[Tuple[str, str, int]] = []  # (ingredient, what, line)
+        fresh: Optional[Set[str]] = None
+        for node in ast.walk(fi.node):
+            tgts: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                tgts = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                tgts = [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Attribute) and t.attr in attr_watch:
+                    if fresh is None:
+                        fresh = _fresh_locals(fi.node, ws, mod, fi)
+                    if isinstance(t.value, ast.Name) and t.value.id in fresh:
+                        continue
+                    writes.append((attr_watch[t.attr],
+                                   f"attribute .{t.attr}", t.lineno))
+                elif isinstance(t, ast.Subscript) \
+                        and _is_os_environ(t.value):
+                    key = _env_key_name(t.slice)
+                    if key in env_watch:
+                        writes.append((env_watch[key],
+                                       f"os.environ[{key}]", t.lineno))
+        if not writes:
+            return
+        if ws.reaches(fi, targets):
+            return
+        for ing, what, line in writes:
+            yield Finding(
+                self.name, mod.path, line,
+                f"{fi.qualname}() writes plan-key ingredient "
+                f"'{ing}' ({what}) but never reaches "
+                "invalidate_fused_plans()/invalidate_megaplan() — a "
+                "cached fused plan or captured megaplan would replay "
+                "stale state")
+
+    # -- registry <-> key-builder cross-checks -------------------------
+
+    def _registry_cross_check(self, ws, project, attr_watch,
+                              env_watch) -> Iterable[Finding]:
+        key_builder_mods = [m for m in ws.modules.values()
+                            if "_PLAN_KEY" in m.global_names]
+        # absence checks need the whole package (or at least a key-builder
+        # module in the run); a subset lint cannot prove absence
+        if COLLECTIVES_REL not in ws.modules and not key_builder_mods:
+            return
+        seen_attrs: Set[str] = set()
+        read_consts: Set[str] = set()
+        for mod in ws.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute):
+                    seen_attrs.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    read_consts.add(node.id)
+        for attr in sorted(attr_watch):
+            if attr not in seen_attrs:
+                yield Finding(
+                    self.name, COLLECTIVES_REL,
+                    project.plan_key_sources_line,
+                    f"PLAN_KEY_SOURCES watches 'attr:{attr}' "
+                    f"(ingredient '{attr_watch[attr]}') but no such "
+                    "attribute exists anywhere in the package — the "
+                    "knob was renamed or removed")
+        for const in sorted(env_watch):
+            if const not in read_consts:
+                yield Finding(
+                    self.name, COLLECTIVES_REL,
+                    project.plan_key_sources_line,
+                    f"PLAN_KEY_SOURCES watches 'env:{const}' "
+                    f"(ingredient '{env_watch[const]}') but the constant "
+                    "is referenced nowhere in the package")
+        # reverse: env-reading helpers called inside key tuples need specs
+        for mod in key_builder_mods:
+            yield from self._check_key_builders(mod, env_watch)
+
+    def _check_key_builders(self, mod: flow.ModuleInfo,
+                            env_watch: Dict[str, str]) -> Iterable[Finding]:
+        for fi in mod.functions.values():
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Tuple)
+                        and node.value.elts):
+                    continue
+                head = node.value.elts[0]
+                if not (isinstance(head, ast.Name)
+                        and head.id == "_PLAN_KEY"):
+                    continue
+                for elt in node.value.elts[1:]:
+                    if not (isinstance(elt, ast.Call)
+                            and isinstance(elt.func, ast.Name)):
+                        continue
+                    helper = mod.functions.get(elt.func.id)
+                    if helper is None:
+                        continue
+                    for const in sorted(_env_reads(helper.node)):
+                        if const not in env_watch:
+                            yield Finding(
+                                self.name, mod.path, elt.lineno,
+                                f"plan key element {elt.func.id}() reads "
+                                f"{const} but PLAN_KEY_SOURCES has no "
+                                f"'env:{const}' entry — writes to it "
+                                "would bypass the invalidation watch")
+
+
+def _env_reads(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if not (isinstance(sub, ast.Call) and sub.args):
+            continue
+        if flow.call_name(sub).rsplit(".", 1)[-1] not in _ENV_READERS:
+            continue
+        name = _env_key_name(sub.args[0])
+        if name and name.startswith("HOROVOD_"):
+            out.add(name)
+    return out

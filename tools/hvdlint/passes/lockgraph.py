@@ -1,0 +1,408 @@
+"""Pass (d): static lock-order graph.
+
+The runtime half of this contract is ``utils/lockcheck.py``: under
+``HOROVOD_LOCKCHECK=1`` every ``make_lock("module.role")`` acquisition
+is recorded and held->acquired edges are checked online for cycles. The
+static half built here never needs the env flag: it recovers the same
+graph from source.
+
+- **Nodes** are the literal names passed to ``lockcheck.make_lock()`` /
+  ``make_rlock()`` and assigned to ``self.<attr>``.
+- **Edges** come from three syntactic sources, all computed per class so
+  the ubiquitous attribute name ``_lock`` resolves to the right node:
+
+  1. lexical nesting — ``with self._a:`` containing ``with self._b:``;
+  2. calls made while holding — a call inside ``with self._a:`` that
+     statically resolves (same class, same module, or imported module;
+     plus a same-named-method fallback, applied transitively through
+     callees, when exactly one lock-acquiring class defines that method
+     name) contributes an edge to every lock the callee can
+     transitively acquire;
+  3. ``# guarded-by:`` annotations — a method touching a guarded
+     attribute without the ``with`` runs with that lock already held
+     (its callers hold it), so locks it acquires get edges from the
+     guard.
+
+A cycle in this graph is a finding at lint time — before any thread
+interleaving can demonstrate it. The graph is also exported
+(:func:`build_lock_graph`, CLI ``--lock-graph``) so the tier-1 test can
+assert that every edge the runtime auditor observed during the suite is
+present in the static graph: runtime ⊆ static, i.e. the prover's
+over-approximation never *misses* a real acquisition order.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import flow
+from ..core import FileContext, Finding, Project
+from ..rules import GUARDED_BY_RE
+
+_MAKERS = {"make_lock", "make_rlock"}
+
+
+def _str_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _FnLocks:
+    """Lock facts for one function: what it acquires directly, and what
+    happens while something is held."""
+
+    def __init__(self):
+        self.direct: Set[str] = set()
+        # (held lock, acquired lock, line) from lexical nesting
+        self.nest_edges: List[Tuple[str, str, int]] = []
+        # (held locks, call node) for cross-function edges
+        self.calls_held: List[Tuple[Tuple[str, ...], ast.Call]] = []
+        self.all_calls: List[ast.Call] = []
+
+
+class LockOrderPass:
+    """See module docstring. After ``finalize`` runs, ``self.graph``
+    holds the exported ``{"nodes": [...], "edges": [...]}`` dict."""
+
+    name = "lock-order"
+
+    def __init__(self):
+        self._files: Dict[str, FileContext] = {}
+        self.graph: Dict[str, list] = {"nodes": [], "edges": []}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.in_package():
+            self._files[ctx.path] = ctx
+        return ()
+
+    # ------------------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        if not self._files:
+            return
+        ws = flow.Workspace({p: flow.module_info(p, c.tree)
+                             for p, c in self._files.items()})
+        # (module path, class name, attr) -> lock name
+        registry: Dict[Tuple[str, Optional[str], str], str] = {}
+        for mod in ws.modules.values():
+            for fi in mod.functions.values():
+                for node in ast.walk(fi.node):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    call = node.value
+                    tail = flow.call_name(call).rsplit(".", 1)[-1]
+                    if tail not in _MAKERS or not call.args:
+                        continue
+                    name = _str_const(call.args[0])
+                    if name is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            registry[(mod.path, fi.cls, t.attr)] = name
+        if not registry:
+            self.graph = {"nodes": [], "edges": []}
+            return
+
+        facts: Dict[Tuple[str, str], _FnLocks] = {}
+        for mod in ws.modules.values():
+            for fi in mod.functions.values():
+                facts[(mod.path, fi.qualname)] = \
+                    self._analyze(mod, fi, registry)
+
+        closure, name_fallback = self._closure(ws, facts)
+
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        def add_edge(a: str, b: str, path: str, line: int) -> None:
+            if a != b:
+                edges.setdefault((a, b), (path, line))
+
+        for (path, qual), fl in facts.items():
+            for a, b, line in fl.nest_edges:
+                add_edge(a, b, path, line)
+            for held, call in fl.calls_held:
+                for lock in self._callee_locks(ws, path, qual, call,
+                                               closure, name_fallback):
+                    for h in held:
+                        add_edge(h, lock, path, call.lineno)
+        # guarded-by annotations: a method touching a guarded attr
+        # without the with runs with the lock held — its acquisitions
+        # order after it
+        for path, qual, lock, acq, line in \
+                self._guarded_by_edges(ws, registry, facts, closure):
+            add_edge(lock, acq, path, line)
+
+        nodes = sorted(set(registry.values()))
+        self.graph = {
+            "nodes": nodes,
+            "edges": [{"from": a, "to": b, "at": f"{p}:{ln}"}
+                      for (a, b), (p, ln) in sorted(edges.items())],
+        }
+        yield from self._cycles(edges)
+
+    # -- per-function extraction ---------------------------------------
+
+    def _analyze(self, mod: flow.ModuleInfo, fi: flow.FuncInfo,
+                 registry) -> _FnLocks:
+        fl = _FnLocks()
+
+        def lock_of(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                return registry.get((mod.path, fi.cls, expr.attr))
+            return None
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    nm = lock_of(item.context_expr)
+                    if nm is not None:
+                        fl.direct.add(nm)
+                        for h in held:
+                            fl.nest_edges.append((h, nm, node.lineno))
+                        acquired.append(nm)
+                inner = held + tuple(acquired)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                fl.all_calls.append(node)
+                if held:
+                    fl.calls_held.append((held, node))
+            # do not descend into nested defs: their bodies run later
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fi.node:
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fi.node.body:
+            visit(stmt, ())
+        return fl
+
+    # -- transitive lock closure ---------------------------------------
+
+    def _closure(self, ws: flow.Workspace, facts
+                 ) -> Tuple[Dict[Tuple[str, str], Set[str]],
+                            Dict[str, Set[str]]]:
+        """``(closure, name_fallback)``: the locks each function can
+        acquire, directly or transitively. Computed as a fixpoint (no
+        depth bound; call cycles converge naturally) in two rounds:
+        first over statically resolved calls only, then — after deriving
+        the unique-method-name fallback from that sound core — again
+        with unresolved ``obj.method()`` calls contributing the fallback
+        locks, so ``reg.counter()`` through an untyped local still
+        propagates the registry lock to everything that calls it while
+        holding another lock."""
+        resolved: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        attr_calls: Dict[Tuple[str, str], Set[str]] = {}
+        for key, fl in facts.items():
+            path, qual = key
+            mod = ws.modules.get(path)
+            fi = mod.functions.get(qual) if mod is not None else None
+            hits: List[Tuple[str, str]] = []
+            names: Set[str] = set()
+            if mod is not None and fi is not None:
+                for call in fl.all_calls:
+                    hit = ws.resolve_call(call, fi, mod)
+                    if hit is not None:
+                        hits.append((hit.module, hit.qualname))
+                    elif isinstance(call.func, ast.Attribute):
+                        names.add(call.func.attr)
+            resolved[key] = hits
+            attr_calls[key] = names
+
+        memo = {key: set(fl.direct) for key, fl in facts.items()}
+
+        def fixpoint(fallback: Dict[str, Set[str]]) -> None:
+            changed = True
+            while changed:
+                changed = False
+                for key in facts:
+                    cur = memo[key]
+                    before = len(cur)
+                    for ck in resolved[key]:
+                        cur |= memo.get(ck, set())
+                    if fallback:
+                        for an in attr_calls[key]:
+                            cur |= fallback.get(an, set())
+                    if len(cur) != before:
+                        changed = True
+
+        fixpoint({})
+        name_fallback = self._method_name_fallback(ws, facts, memo)
+        fixpoint(name_fallback)
+        return memo, name_fallback
+
+    @staticmethod
+    def _method_name_fallback(ws, facts, closure
+                              ) -> Dict[str, Set[str]]:
+        """method name -> locks, for methods of lock-owning classes whose
+        name is unique among lock-acquiring methods — lets ``reg.foo()``
+        through an untyped local still contribute its edges."""
+        by_name: Dict[str, List[Set[str]]] = {}
+        for (path, qual), locks in closure.items():
+            if not locks or "." not in qual:
+                continue
+            by_name.setdefault(qual.rsplit(".", 1)[-1], []).append(locks)
+        return {name: sets[0] for name, sets in by_name.items()
+                if len(sets) == 1}
+
+    def _callee_locks(self, ws, path, qual, call, closure,
+                      name_fallback) -> Set[str]:
+        mod = ws.modules.get(path)
+        fi = mod.functions.get(qual) if mod is not None else None
+        if mod is not None and fi is not None:
+            hit = ws.resolve_call(call, fi, mod)
+            if hit is not None:
+                return closure.get((hit.module, hit.qualname), set())
+        if isinstance(call.func, ast.Attribute):
+            return name_fallback.get(call.func.attr, set())
+        return set()
+
+    # -- guarded-by contribution ---------------------------------------
+
+    def _guarded_by_edges(self, ws, registry, facts, closure):
+        for path, ctx in self._files.items():
+            mod = ws.modules[path]
+            annotations = []  # (line, lock attr)
+            for i, line in enumerate(ctx.lines, start=1):
+                m = GUARDED_BY_RE.search(line)
+                if m:
+                    annotations.append((i, m.group(1)))
+            for line, lock_attr in annotations:
+                owner = self._annotated_class(mod, line)
+                if owner is None:
+                    continue
+                cls, attr = owner
+                lock = registry.get((path, cls, lock_attr))
+                if lock is None:
+                    continue
+                for fi in mod.functions.values():
+                    if fi.cls != cls or fi.name == "__init__":
+                        continue
+                    for al in self._unguarded_touch_lines(
+                            fi.node, attr, lock_attr):
+                        for acq in closure.get((path, fi.qualname), ()):
+                            yield path, fi.qualname, lock, acq, al
+
+    @staticmethod
+    def _annotated_class(mod: flow.ModuleInfo,
+                         line: int) -> Optional[Tuple[str, str]]:
+        """(class, attr) of the ``self.<attr> = ...`` whose span covers
+        the annotated line."""
+        for fi in mod.functions.values():
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if not (node.lineno <= line
+                        <= (node.end_lineno or node.lineno)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        return fi.cls, t.attr
+        return None
+
+    @staticmethod
+    def _unguarded_touch_lines(fn: ast.AST, attr: str,
+                               lock_attr: str) -> List[int]:
+        out: List[int] = []
+
+        def holds(withstmt) -> bool:
+            for item in withstmt.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and e.attr == lock_attr \
+                        and isinstance(e.value, ast.Name) \
+                        and e.value.id == "self":
+                    return True
+            return False
+
+        def visit(node, held: bool) -> None:
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and node.attr == attr \
+                    and not held:
+                out.append(node.lineno)
+            child_held = held or (
+                isinstance(node, (ast.With, ast.AsyncWith)) and holds(node))
+            for child in ast.iter_child_nodes(node):
+                visit(child, child_held)
+
+        for stmt in fn.body:
+            visit(stmt, False)
+        return out
+
+    # -- cycle detection -----------------------------------------------
+
+    def _cycles(self, edges: Dict[Tuple[str, str], Tuple[str, int]]
+                ) -> Iterable[Finding]:
+        succ: Dict[str, List[str]] = {}
+        for a, b in edges:
+            succ.setdefault(a, []).append(b)
+        seen: Set[str] = set()
+        reported: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, stack: List[str], on_stack: Set[str]):
+            seen.add(node)
+            stack.append(node)
+            on_stack.add(node)
+            for nxt in sorted(succ.get(node, ())):
+                if nxt in on_stack:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    canon = tuple(sorted(set(cyc)))
+                    if canon not in reported:
+                        reported.add(canon)
+                        yield cyc
+                elif nxt not in seen:
+                    yield from dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(node)
+
+        for start in sorted(succ):
+            if start not in seen:
+                for cyc in dfs(start, [], set()):
+                    a, b = cyc[0], cyc[1]
+                    path, line = edges[(a, b)]
+                    yield Finding(
+                        self.name, path, line,
+                        "static lock-order cycle: "
+                        + " -> ".join(cyc)
+                        + " — two threads taking these locks in opposing "
+                        "order can deadlock; break the cycle or lift one "
+                        "acquisition out")
+
+
+def build_lock_graph(root: str) -> Dict[str, list]:
+    """Run just the lock-order pass over ``<root>/horovod_tpu`` and
+    return the static acquisition graph (the tier-1 runtime-consistency
+    test and the CLI ``--lock-graph`` flag both use this)."""
+    import os
+
+    from ..core import Project, iter_py_files
+
+    project = Project.from_root(root)
+    rule = LockOrderPass()
+    for path in iter_py_files([os.path.join(root, "horovod_tpu")]):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            ctx = FileContext(rel, source, project)
+        except SyntaxError:
+            continue
+        rule.check_file(ctx)
+    list(rule.finalize(project))
+    return rule.graph

@@ -1,0 +1,267 @@
+"""Pass (c): wire-protocol state-machine coverage.
+
+The negotiation channel speaks five frame kinds: v1 JSON (``{``/``[``),
+the three magic-prefixed v2 binary kinds declared in ops/wire.py
+(``KIND_SUBMIT``/``KIND_AGG``/``KIND_RESP``), and the 1-byte
+``SAME_AS_LAST`` marker (also the megaplan lease probe). This pass
+extracts, from the AST of ops/wire.py + ops/controller.py, which kinds
+each controller function *emits* (encode_* calls, ``.encode()`` on an
+attribute built from a wire encoder class, ``json.dumps``, marker used
+as a value) and which it *accepts* (decode_* calls, ``.decode()`` on a
+wire decoder attribute, ``json.loads``, marker equality compares), then
+checks the coverage obligations of the protocol's states:
+
+1. **Alphabet completeness** — every kind wire.py declares must have at
+   least one emit site and one accept site in the controller; a kind
+   with an encoder but no decoder arm is an uncovered (state, frame)
+   pair waiting for a live handshake to find it.
+2. **Marker coverage** — any function decoding v2 submissions must also
+   carry a ``SAME_AS_LAST`` equality arm: a worker whose payload is
+   byte-identical to the previous round sends the 1-byte marker in
+   *every* state (it is also the lease probe), so a submission decoder
+   without the marker arm drops lease and cache-hit rounds.
+3. **Mixed-mode aggregate coverage** — a submission decoder that still
+   accepts v1 JSON is the top-level coordinator inbox (it serves both
+   protocol states at once); it must also accept the v2 aggregate kind,
+   because group leaders submit merged frames to the same inbox.
+   (A decoder *without* a JSON arm is a v2-only leaf — the group-merge
+   state — whose alphabet is just {marker, submit}.)
+4. **JSON fallback on the response channel** — any function decoding v2
+   responses must also call ``json.loads``: error-close and abort
+   responses are always v1 JSON regardless of the negotiated state, so
+   a binary-only response decoder cannot decode its own abort.
+
+The state machines are derived, not hand-kept: adding ``KIND_X`` to
+wire.py with no controller arm, or removing an arm, fails the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import flow
+from ..core import FileContext, Finding, Project
+
+WIRE_SUFFIX = "ops/wire.py"
+CONTROLLER_SUFFIX = "ops/controller.py"
+
+_MARKER = "SAME_AS_LAST"
+
+
+def _is_marker_ref(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id == _MARKER) or \
+        (isinstance(node, ast.Attribute) and node.attr == _MARKER)
+
+
+def _contains_marker(node: ast.AST) -> bool:
+    return any(_is_marker_ref(n) for n in ast.walk(node))
+
+
+class _WireModel:
+    """Frame kinds and codec entry points extracted from ops/wire.py."""
+
+    def __init__(self, tree: ast.Module):
+        # KIND_* constant name -> (kind label, declaration line)
+        self.kinds: Dict[str, Tuple[str, int]] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.startswith("KIND_"):
+                name = node.targets[0].id
+                self.kinds[name] = (name[len("KIND_"):].lower(),
+                                    node.lineno)
+        # function name -> ("enc"|"dec", kind); class name -> kind for
+        # encoder/decoder classes (those with encode()/decode() methods)
+        self.funcs: Dict[str, Tuple[str, str]] = {}
+        self.enc_classes: Dict[str, str] = {}
+        self.dec_classes: Dict[str, str] = {}
+        for node in tree.body:
+            refs = {self.kinds[n.id][0] for n in ast.walk(node)
+                    if isinstance(n, ast.Name) and n.id in self.kinds}
+            if len(refs) != 1:
+                continue
+            kind = next(iter(refs))
+            if isinstance(node, ast.FunctionDef):
+                if node.name.startswith("encode"):
+                    self.funcs[node.name] = ("enc", kind)
+                elif node.name.startswith("decode"):
+                    self.funcs[node.name] = ("dec", kind)
+            elif isinstance(node, ast.ClassDef):
+                methods = {m.name for m in node.body
+                           if isinstance(m, ast.FunctionDef)}
+                if "encode" in methods:
+                    self.enc_classes[node.name] = kind
+                if "decode" in methods:
+                    self.dec_classes[node.name] = kind
+
+
+class _FnUsage:
+    """Per-controller-function emit/accept sets with witness lines."""
+
+    def __init__(self, fi: flow.FuncInfo):
+        self.fi = fi
+        self.emits: Dict[str, int] = {}
+        self.accepts: Dict[str, int] = {}
+
+    def emit(self, kind: str, line: int) -> None:
+        self.emits.setdefault(kind, line)
+
+    def accept(self, kind: str, line: int) -> None:
+        self.accepts.setdefault(kind, line)
+
+
+class ProtocolCoveragePass:
+    """See module docstring."""
+
+    name = "protocol-coverage"
+
+    def __init__(self):
+        self._wire: Optional[ast.Module] = None
+        self._controller: Optional[Tuple[str, ast.Module]] = None
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.endswith(WIRE_SUFFIX):
+            self._wire = ctx.tree
+        elif ctx.path.endswith(CONTROLLER_SUFFIX):
+            self._controller = (ctx.path, ctx.tree)
+        return ()
+
+    # ------------------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        if self._wire is None or self._controller is None:
+            return  # subset lint: both machines are needed to compare
+        wire = _WireModel(self._wire)
+        if not wire.kinds:
+            return
+        path, tree = self._controller
+        mod = flow.module_info(path, tree)
+        enc_attrs, dec_attrs = self._codec_attrs(tree, wire)
+        usages = [self._analyze(fi, wire, enc_attrs, dec_attrs)
+                  for fi in mod.functions.values()]
+
+        # 1. alphabet completeness (module-wide union, incl. marker)
+        all_emits: Dict[str, int] = {}
+        all_accepts: Dict[str, int] = {}
+        for u in usages:
+            for k, ln in u.emits.items():
+                all_emits.setdefault(k, ln)
+            for k, ln in u.accepts.items():
+                all_accepts.setdefault(k, ln)
+        wire_path = path[:-len(CONTROLLER_SUFFIX)] + WIRE_SUFFIX
+        for const, (kind, line) in sorted(wire.kinds.items()):
+            if kind not in all_emits:
+                yield Finding(
+                    self.name, wire_path, line,
+                    f"wire declares frame kind {const} but no controller "
+                    "send-site emits it — dead protocol surface or a "
+                    "missing sender")
+            if kind not in all_accepts:
+                yield Finding(
+                    self.name, wire_path, line,
+                    f"wire declares frame kind {const} but no controller "
+                    "handler accepts it — a peer emitting this frame "
+                    "hits an uncovered (state, frame) pair")
+        if "marker" in all_emits and "marker" not in all_accepts:
+            yield Finding(
+                self.name, path, all_emits["marker"],
+                "SAME_AS_LAST marker is emitted but no handler compares "
+                "for it — cache-hit/lease rounds would be undecodable")
+
+        submit_kinds = {k for op, k in wire.funcs.values() if op == "dec"} \
+            - set(wire.dec_classes.values())
+        agg_kinds = {k for k in submit_kinds if "agg" in k}
+        resp_kinds = set(wire.dec_classes.values())
+        for u in usages:
+            got = u.accepts
+            accepts_submit = any(k in got for k in submit_kinds - agg_kinds)
+            # 2. marker coverage for submission decoders
+            if accepts_submit and "marker" not in got:
+                yield Finding(
+                    self.name, path, u.fi.node.lineno,
+                    f"{u.fi.qualname}() decodes v2 submissions but has "
+                    "no SAME_AS_LAST marker arm — an unchanged-payload "
+                    "or lease-probe round from a worker would be "
+                    "undecodable in this state")
+            # 3. mixed-mode inbox must cover aggregates
+            if accepts_submit and "v1_json" in got \
+                    and agg_kinds and not any(k in got for k in agg_kinds):
+                yield Finding(
+                    self.name, path, u.fi.node.lineno,
+                    f"{u.fi.qualname}() is a mixed-mode submission inbox "
+                    "(v1 JSON + v2 submit arms) but has no aggregate "
+                    "arm — a group leader's merged frame would be "
+                    "undecodable")
+            # 4. response decoders need the JSON fallback
+            if any(k in got for k in resp_kinds) and "v1_json" not in got:
+                yield Finding(
+                    self.name, path, u.fi.node.lineno,
+                    f"{u.fi.qualname}() decodes v2 responses without a "
+                    "json.loads fallback — error-close/abort responses "
+                    "are always v1 JSON, so this state cannot decode "
+                    "its own abort")
+
+    # -- extraction ----------------------------------------------------
+
+    @staticmethod
+    def _codec_attrs(tree: ast.Module, wire: _WireModel
+                     ) -> Tuple[Dict[str, str], Dict[str, str]]:
+        """Attributes assigned from wire encoder/decoder constructors
+        (``self._resp_enc = wire_mod.ResponseEncoder(...)``)."""
+        enc_attrs: Dict[str, str] = {}
+        dec_attrs: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            tail = flow.call_name(node.value).rsplit(".", 1)[-1]
+            for t in node.targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                if tail in wire.enc_classes:
+                    enc_attrs[t.attr] = wire.enc_classes[tail]
+                if tail in wire.dec_classes:
+                    dec_attrs[t.attr] = wire.dec_classes[tail]
+        return enc_attrs, dec_attrs
+
+    @staticmethod
+    def _analyze(fi: flow.FuncInfo, wire: _WireModel,
+                 enc_attrs: Dict[str, str],
+                 dec_attrs: Dict[str, str]) -> "_FnUsage":
+        u = _FnUsage(fi)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                cn = flow.call_name(node)
+                tail = cn.rsplit(".", 1)[-1]
+                hit = wire.funcs.get(tail)
+                if hit is not None:
+                    op, kind = hit
+                    (u.emit if op == "enc" else u.accept)(kind, node.lineno)
+                elif cn == "json.loads":
+                    # only a bare-Name argument is a *frame* decode
+                    # (``json.loads(raw)``); a slice or expression
+                    # (``json.loads(raw[1:])``) parses an embedded
+                    # payload — e.g. the marker's timestamp suffix —
+                    # and does not make the function a v1 inbox
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        u.accept("v1_json", node.lineno)
+                elif cn == "json.dumps":
+                    u.emit("v1_json", node.lineno)
+                elif isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Attribute):
+                    owner = node.func.value.attr
+                    if node.func.attr == "encode" and owner in enc_attrs:
+                        u.emit(enc_attrs[owner], node.lineno)
+                    elif node.func.attr == "decode" and owner in dec_attrs:
+                        u.accept(dec_attrs[owner], node.lineno)
+                if any(_contains_marker(a) for a in node.args):
+                    u.emit("marker", node.lineno)
+            elif isinstance(node, ast.Compare):
+                if _contains_marker(node):
+                    u.accept("marker", node.lineno)
+            elif isinstance(node, ast.Assign):
+                if not isinstance(node.value, ast.Compare) \
+                        and _contains_marker(node.value):
+                    u.emit("marker", node.lineno)
+        return u

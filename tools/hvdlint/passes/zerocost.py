@@ -1,0 +1,382 @@
+"""Pass (a): the zero-cost-gate prover.
+
+Nine-plus subsystems promise that when disabled they cost one pointer
+check per hook. The per-file ``zero-cost-hooks`` rule enforces guard
+ordering for handles it can recognize *by name*; this pass derives the
+real handle vocabulary from the package itself and proves the contract
+for every registered subsystem:
+
+1. The gate list is ``GATED_SUBSYSTEMS`` in common/env.py (master-switch
+   constant -> gated module). No hand-kept table here — renaming a
+   switch or adding a subsystem updates the prover automatically, and a
+   module that *looks* gated (module-level ``enabled()`` reading a
+   schema switch plus a module-global None handle) but is missing from
+   the registry is itself a finding.
+2. Per subsystem the prover derives: the module-global None handles
+   (``_TRACER = None``), the accessor functions returning them
+   (``get_tracer``), the module's ``enabled()``, and every attribute
+   anywhere in the package assigned from an accessor or a constructor of
+   the gated module (``self.tracer = tracing_mod.get_tracer()``,
+   ``_ctx.autotuner = Autotuner(...)``) — the cross-module hook handles.
+3. A *hook* is any package function gating on one of those handles:
+   ``if X is None: return``, ``if X is not None: ...``,
+   ``if not enabled(): return`` or ``if enabled(): ...``. For *bail*
+   guards the statements before the guard ARE the disabled path (the
+   function aborts right after them when the feature is off), so they
+   must not build f-strings, ``.format()``/%-format, call ``time.*``,
+   allocate via a comprehension, or touch the metrics registry. A
+   *wrapper* guard at the function tail proves the hook costs one check
+   but says nothing about the statements before it — they run
+   unconditionally for the function's own sake (a controller round that
+   happens to end with an optional flightrec note is not a flightrec
+   hook-body).
+4. Coverage: every registered subsystem must read its switch somewhere
+   (``get_bool(HOROVOD_X)``) and have at least one provable hook; a
+   registry entry pointing at a module with neither is reported, so the
+   prover can never silently cover nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import flow
+from ..core import ENV_SCHEMA_REL, FileContext, Finding, Project
+
+_ENV_READERS = {"get_bool", "get_int", "get_float", "get_str", "get",
+                "getenv"}
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _str_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _names_const(arg: ast.expr, switch: str) -> bool:
+    """Does this env-reader argument denote the switch constant?"""
+    return (isinstance(arg, ast.Name) and arg.id == switch) \
+        or (isinstance(arg, ast.Attribute) and arg.attr == switch) \
+        or (isinstance(arg, ast.Constant) and arg.value == switch)
+
+
+def _env_read_consts(node: ast.AST) -> Set[str]:
+    """HOROVOD_* constants consulted via env-reader calls inside node."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Call) and sub.args):
+            continue
+        tail = flow.call_name(sub).rsplit(".", 1)[-1]
+        if tail not in _ENV_READERS:
+            continue
+        arg = sub.args[0]
+        for cand in (getattr(arg, "id", None), getattr(arg, "attr", None),
+                     getattr(arg, "value", None)):
+            if isinstance(cand, str) and cand.startswith("HOROVOD_"):
+                out.add(cand)
+    return out
+
+
+def _returns_one_of(fn: ast.AST, names: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name) \
+                and node.value.id in names:
+            return True
+    return False
+
+
+def _bails(body: List[ast.stmt]) -> bool:
+    return all(isinstance(s, (ast.Return, ast.Raise, ast.Pass))
+               for s in body)
+
+
+class _Subsystem:
+    """Derived vocabulary for one GATED_SUBSYSTEMS entry."""
+
+    def __init__(self, switch: str, rel: str, mod: flow.ModuleInfo):
+        self.switch = switch
+        self.rel = rel
+        self.globals: Set[str] = set(mod.global_none)
+        self.accessors: Set[str] = {
+            fi.name for fi in mod.functions.values()
+            if fi.cls is None and _returns_one_of(fi.node, self.globals)}
+        self.has_enabled = any(
+            fi.cls is None and fi.name == "enabled"
+            for fi in mod.functions.values())
+        self.attrs: Set[str] = set()  # cross-module handle attributes
+        self.hooks = 0
+
+
+class ZeroCostGatePass:
+    """See module docstring. Findings carry the hook's line; coverage
+    findings land on the GATED_SUBSYSTEMS declaration in common/env.py."""
+
+    name = "zero-cost-gates"
+
+    def __init__(self):
+        self._trees: Dict[str, ast.Module] = {}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.in_package():
+            self._trees[ctx.path] = ctx.tree
+        return ()
+
+    # ------------------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        gates = project.gated_subsystems
+        if not gates or not self._trees:
+            return
+        ws = flow.Workspace({p: flow.module_info(p, t)
+                             for p, t in self._trees.items()})
+        subsystems: List[_Subsystem] = []
+        for switch, rel in sorted(gates.items()):
+            mod = ws.modules.get(rel)
+            if mod is None:
+                # entry points at a module outside this lint run; only a
+                # whole-package run (schema module present) can judge it
+                if ENV_SCHEMA_REL in ws.modules:
+                    yield Finding(
+                        self.name, ENV_SCHEMA_REL,
+                        project.gated_subsystems_line,
+                        f"GATED_SUBSYSTEMS maps {switch} to {rel}, which "
+                        "does not exist in the linted tree")
+                continue
+            subsystems.append(_Subsystem(switch, rel, mod))
+        self._derive_attr_handles(ws, subsystems)
+
+        for mod in ws.modules.values():
+            for fi in mod.functions.values():
+                yield from self._check_hook(ws, mod, fi, subsystems)
+
+        yield from self._coverage(ws, project, subsystems)
+        yield from self._unregistered_trios(ws, gates)
+
+    # -- vocabulary ----------------------------------------------------
+
+    def _derive_attr_handles(self, ws: flow.Workspace,
+                             subsystems: List[_Subsystem]) -> None:
+        """Attributes assigned anywhere in the package from a gated
+        module's accessor or constructor become hook handles for that
+        subsystem (``self.tracer = tracing_mod.get_tracer()``)."""
+        by_rel = {s.rel: s for s in subsystems}
+        for mod in ws.modules.values():
+            dummy = flow.FuncInfo(mod.path, "<module>", "<module>",
+                                  None, mod.tree)
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                hit = ws.resolve_call(node.value, dummy, mod)
+                if hit is None:
+                    continue
+                sub = by_rel.get(hit.module)
+                if sub is None:
+                    continue
+                if hit.name not in sub.accessors and hit.name != "__init__":
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        sub.attrs.add(t.attr)
+
+    # -- hook checking -------------------------------------------------
+
+    def _handle_subsystem(self, expr: ast.expr, mod: flow.ModuleInfo,
+                          subsystems: List[_Subsystem],
+                          local_handles: Dict[str, _Subsystem]
+                          ) -> Optional[_Subsystem]:
+        """The subsystem a guard expression's handle belongs to."""
+        if isinstance(expr, ast.Name):
+            if expr.id in local_handles:
+                return local_handles[expr.id]
+            for s in subsystems:
+                if mod.path == s.rel and expr.id in s.globals:
+                    return s
+        elif isinstance(expr, ast.Attribute):
+            for s in subsystems:
+                if expr.attr in s.attrs:
+                    return s
+                if mod.path == s.rel and expr.attr in s.globals:
+                    return s
+        return None
+
+    def _guard_subsystem(self, stmt: ast.stmt, rest: List[ast.stmt],
+                         ws: flow.Workspace, mod: flow.ModuleInfo,
+                         fi: flow.FuncInfo,
+                         subsystems: List[_Subsystem],
+                         local_handles: Dict[str, _Subsystem]
+                         ) -> Optional[Tuple[_Subsystem, bool]]:
+        """``(subsystem, is_bail)`` if this statement is a gate guard.
+
+        Bail guards (``if X is None: return``) count anywhere: when the
+        feature is off the function dies here, so everything before is
+        the disabled path. Wrapper guards (``if X is not None: ...`` /
+        ``if enabled(): ...``) only count when nothing but returns
+        follows (``rest``) — a wrapper mid-function is just conditional
+        work, not a gate — and they never indict the statements before
+        them (those run unconditionally, enabled or not)."""
+        if not isinstance(stmt, ast.If):
+            return None
+        tail_ok = _bails(rest) if rest else True
+        t = stmt.test
+        # if not enabled(): return   /   if enabled(): ...
+        call = None
+        is_bail = False
+        if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not) \
+                and isinstance(t.operand, ast.Call):
+            call, is_bail = t.operand, True
+        elif isinstance(t, ast.Call):
+            call = t
+        if call is not None:
+            hit = ws.resolve_call(call, fi, mod)
+            if hit is not None and hit.name == "enabled":
+                for s in subsystems:
+                    if hit.module != s.rel:
+                        continue
+                    if is_bail and _bails(stmt.body):
+                        return s, True
+                    if not is_bail and tail_ok:
+                        return s, False
+            return None
+        # if X is None: return   /   if X is not None: ...
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 \
+                and isinstance(t.comparators[0], ast.Constant) \
+                and t.comparators[0].value is None:
+            sub = self._handle_subsystem(t.left, mod, subsystems,
+                                         local_handles)
+            if sub is None:
+                return None
+            if isinstance(t.ops[0], ast.Is) and _bails(stmt.body):
+                return sub, True
+            if isinstance(t.ops[0], ast.IsNot) and tail_ok:
+                return sub, False
+        return None
+
+    def _check_hook(self, ws: flow.Workspace, mod: flow.ModuleInfo,
+                    fi: flow.FuncInfo,
+                    subsystems: List[_Subsystem]) -> Iterable[Finding]:
+        """If fi gates on a subsystem handle (possibly after a cheap
+        handle fetch), count the hook; for bail guards also scan the
+        pre-guard statements — they are the disabled path."""
+        body = list(fi.node.body)
+        local_handles: Dict[str, _Subsystem] = {}
+        guard_idx = None
+        guard_sub = None
+        guard_bail = False
+        for i, stmt in enumerate(body):
+            hit = self._guard_subsystem(stmt, body[i + 1:], ws, mod, fi,
+                                        subsystems, local_handles)
+            if hit is not None:
+                guard_idx, (guard_sub, guard_bail) = i, hit
+                break
+            # track cheap local fetches: x = _TRACER / x = get_tracer()
+            # / at = self.autotuner
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt = stmt.targets[0].id
+                v = stmt.value
+                if isinstance(v, ast.Name):
+                    for s in subsystems:
+                        if mod.path == s.rel and v.id in s.globals:
+                            local_handles[tgt] = s
+                elif isinstance(v, ast.Attribute):
+                    s = self._handle_subsystem(v, mod, subsystems, {})
+                    if s is not None:
+                        local_handles[tgt] = s
+                elif isinstance(v, ast.Call):
+                    hit = ws.resolve_call(v, fi, mod)
+                    if hit is not None:
+                        for s in subsystems:
+                            if hit.module == s.rel \
+                                    and hit.name in s.accessors:
+                                local_handles[tgt] = s
+        if guard_idx is None or guard_sub is None:
+            return
+        guard_sub.hooks += 1
+        if not guard_bail:
+            return  # wrapper guard: nothing before it is gated work
+        for stmt in body[:guard_idx]:
+            yield from self._scan_pre_guard(mod, fi, guard_sub, stmt)
+
+    def _scan_pre_guard(self, mod: flow.ModuleInfo, fi: flow.FuncInfo,
+                        sub: _Subsystem,
+                        stmt: ast.stmt) -> Iterable[Finding]:
+        for node in ast.walk(stmt):
+            bad = None
+            if isinstance(node, ast.JoinedStr):
+                bad = "builds an f-string"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "time":
+                    bad = f"calls time.{attr}()"
+                elif attr == "format":
+                    bad = "calls .format()"
+                elif attr in _REGISTRY_METHODS \
+                        and node.args and _str_const(node.args[0]):
+                    bad = f"registers metric series via .{attr}()"
+            elif isinstance(node, ast.Call) \
+                    and flow.call_name(node).rsplit(".", 1)[-1] \
+                    == "get_registry":
+                bad = "resolves the metrics registry"
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mod) \
+                    and _str_const(node.left) is not None:
+                bad = "%-formats a string"
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                bad = "allocates via a comprehension"
+            if bad:
+                yield Finding(
+                    self.name, mod.path, node.lineno,
+                    f"{fi.qualname}() {bad} before the {sub.switch} gate "
+                    "guard — the disabled path must cost one check")
+
+    # -- coverage ------------------------------------------------------
+
+    def _coverage(self, ws: flow.Workspace, project: Project,
+                  subsystems: List[_Subsystem]) -> Iterable[Finding]:
+        whole_package = ENV_SCHEMA_REL in ws.modules
+        for s in subsystems:
+            switch_read = any(
+                s.switch in _env_read_consts(m.tree)
+                for m in ws.modules.values())
+            if whole_package and not switch_read:
+                yield Finding(
+                    self.name, ENV_SCHEMA_REL,
+                    project.gated_subsystems_line,
+                    f"gated subsystem {s.switch} ({s.rel}): the master "
+                    "switch is never consulted (no get_bool/get_* read "
+                    "anywhere in the package)")
+            if whole_package and s.hooks == 0:
+                yield Finding(
+                    self.name, ENV_SCHEMA_REL,
+                    project.gated_subsystems_line,
+                    f"gated subsystem {s.switch} ({s.rel}): no guarded "
+                    "hook found — nothing in the package checks the "
+                    "is-None/enabled() gate, so the prover covers nothing")
+
+    def _unregistered_trios(self, ws: flow.Workspace,
+                            gates: Dict[str, str]) -> Iterable[Finding]:
+        """A module following the gated-subsystem pattern (module-level
+        enabled() reading a schema switch + a module-global None handle)
+        must be registered, or the prover silently skips it."""
+        registered = set(gates.values())
+        for mod in ws.modules.values():
+            if mod.path in registered or not mod.global_none:
+                continue
+            for fi in mod.functions.values():
+                if fi.cls is not None or fi.name != "enabled":
+                    continue
+                switches = _env_read_consts(fi.node)
+                if switches:
+                    yield Finding(
+                        self.name, mod.path, fi.node.lineno,
+                        f"{mod.path} follows the gated-subsystem pattern "
+                        f"(enabled() reads {sorted(switches)[0]}, module "
+                        "has a None handle) but is not registered in "
+                        "GATED_SUBSYSTEMS (common/env.py) — the "
+                        "zero-cost prover is skipping it")

@@ -471,7 +471,12 @@ class EndpointDocsRule:
 
 def make_rules() -> List:
     """Fresh instances of every active rule (stateful rules accumulate
-    per-run, so each run_lint() gets its own set)."""
+    per-run, so each run_lint() gets its own set). The four dataflow
+    passes (tools/hvdlint/passes/) ride along: per-file they only
+    collect trees; their checks run in finalize over the whole package."""
+    from .passes import (InvalidationFunnelPass, LockOrderPass,
+                         ProtocolCoveragePass, ZeroCostGatePass)
+
     return [
         EnvDisciplineRule(),
         MetricNamesRule(),
@@ -481,4 +486,8 @@ def make_rules() -> List:
         LockDisciplineRule(),
         WallClockRule(),
         EndpointDocsRule(),
+        ZeroCostGatePass(),
+        InvalidationFunnelPass(),
+        ProtocolCoveragePass(),
+        LockOrderPass(),
     ]
